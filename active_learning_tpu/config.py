@@ -1,0 +1,199 @@
+"""Typed configuration for the TPU-native active-learning framework.
+
+Replaces the reference's argparse + ``arg_pools`` dict + ``eval()``-string
+system (reference: src/utils/parser.py, src/arg_pools/*.py, and the
+``eval(f"optim.{...}")`` calls at src/query_strategies/strategy.py:345-350)
+with explicit dataclasses and registries.  No ``eval``/``exec`` anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class LoaderConfig:
+    """Host->device input-pipeline parameters.
+
+    Mirrors the reference's DataLoader kwargs (``loader_tr_args`` /
+    ``loader_te_args``, e.g. src/arg_pools/default.py:7-8).  ``num_workers``
+    maps to prefetch threads in our pipeline; on TPU the heavy lifting
+    (normalize/augment) runs on-device inside the jitted step, so the host
+    only gathers uint8 rows.
+    """
+
+    batch_size: int = 128
+    num_workers: int = 0
+    prefetch: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Optimizer selection.  Reference: ``optimizer``/``optimizer_args`` in
+    arg pools (src/arg_pools/default.py:9-10), instantiated by name via
+    ``eval`` at src/query_strategies/strategy.py:345.  Here: a plain name
+    resolved through an explicit factory in train/optim.py.
+    """
+
+    name: str = "sgd"
+    lr: float = 0.1
+    weight_decay: float = 5e-4
+    momentum: float = 0.9
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """LR schedule stepped once per *epoch*, matching torch's
+    StepLR/CosineAnnealingLR semantics (``scheduler.step()`` per epoch at
+    src/query_strategies/strategy.py:369).
+
+    name: "step" (step_size/gamma) or "cosine" (t_max).
+    """
+
+    name: str = "cosine"
+    step_size: int = 60
+    gamma: float = 0.1
+    t_max: int = 200
+
+
+@dataclasses.dataclass(frozen=True)
+class PretrainedConfig:
+    """SSL / transfer-learning checkpoint ingestion.
+
+    Mirrors ``init_pretrained_ckpt_path`` + ``required_key``/``skip_key``/
+    ``replace_key`` state-dict surgery configured per arg pool
+    (src/arg_pools/ssp_finetuning.py:13-16,34-37) and applied in
+    src/utils/load_pretrained_weights.py.
+    """
+
+    path: Optional[str] = None
+    required_key: Optional[Tuple[str, ...]] = None
+    skip_key: Optional[Tuple[str, ...]] = None
+    replace_key: Optional[Tuple[Tuple[str, str], ...]] = None
+
+    @property
+    def replace_map(self) -> Dict[str, str]:
+        return dict(self.replace_key or ())
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Per-dataset training hyperparameters: one entry of an "arg pool"
+    (reference: the per-dataset dicts in src/arg_pools/*.py).
+    """
+
+    eval_split: float = 0.01
+    loader_tr: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
+    loader_te: LoaderConfig = dataclasses.field(
+        default_factory=lambda: LoaderConfig(batch_size=100))
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    pretrained: PretrainedConfig = dataclasses.field(default_factory=PretrainedConfig)
+    imbalanced_training: bool = False
+
+    @property
+    def has_pretrained(self) -> bool:
+        return self.pretrained.path is not None
+
+
+@dataclasses.dataclass(frozen=True)
+class ImbalanceConfig:
+    """Synthetic class-imbalance parameters.
+
+    Reference: --imbalance_type/--imbalance_factor/--imbalance_seed
+    (src/utils/parser.py:30-39) consumed by
+    src/data_utils/custom_imbalanced_cifar10.py:16-27.
+    """
+
+    imbalance_type: Optional[str] = None  # "exp" | "step" | None
+    imbalance_factor: float = 0.1
+    imbalance_seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class VAALConfig:
+    """VAAL hyperparameters (reference: src/utils/parser.py:81-92)."""
+
+    vae_latent_dim: int = 64
+    adversary_param: float = 10.0
+    lr_vae: float = 5e-5
+    lr_discriminator: float = 1e-3
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    """Top-level experiment configuration: the 30 CLI flags of
+    src/utils/parser.py as one typed object.
+    """
+
+    # Experiment identity / logging
+    project_name: str = "active-learning"
+    exp_name: str = "active_learning"
+    exp_hash: Optional[str] = None
+    log_dir: str = "./logs"
+    ckpt_path: str = "./checkpoint"
+    enable_metrics: bool = True
+
+    # Dataset
+    dataset: str = "cifar10"
+    dataset_dir: Optional[str] = None
+    arg_pool: str = "default"
+    imbalance: ImbalanceConfig = dataclasses.field(default_factory=ImbalanceConfig)
+
+    # Active-learning globals
+    strategy: str = "RandomSampler"
+    rounds: int = 5
+    round_budget: int = 5000
+    freeze_feature: bool = False
+    init_pool_size: int = -1  # -1 => round_budget (main_al.py:74-76)
+    init_pool_type: str = "random"  # "random" | "random_balance"
+
+    # Training
+    model: str = "SSLResNet18"
+    resume_training: bool = False
+    n_epoch: int = 60
+    early_stop_patience: int = 30
+
+    # Debug
+    debug_mode: bool = False
+
+    # Coreset / BADGE partitioning (parser.py:74-79)
+    subset_labeled: Optional[int] = None
+    subset_unlabeled: Optional[int] = None
+    partitions: int = 1
+
+    # VAAL
+    vaal: VAALConfig = dataclasses.field(default_factory=VAALConfig)
+
+    # Seeds (reference hard-codes eval split seed 99 and init pool seed 98,
+    # main_al.py:71,83; the rest of the run uses the global np.random state —
+    # here everything is explicit).
+    eval_split_seed: int = 99
+    init_pool_seed: int = 98
+    run_seed: int = 0
+
+    # Mesh / parallelism (replaces world_size = torch.cuda.device_count(),
+    # main_al.py:96; -1 = all local devices)
+    num_devices: int = -1
+
+    def resolved_init_pool_size(self) -> int:
+        if self.init_pool_size == -1:
+            return int(self.round_budget)
+        return int(self.init_pool_size)
+
+
+def config_to_dict(cfg: Any) -> Dict[str, Any]:
+    """Flatten a (possibly nested) dataclass config into a plain dict for
+    metric-parameter logging (reference logs vars(args) at main_al.py:114)."""
+    out: Dict[str, Any] = {}
+
+    def _walk(prefix: str, obj: Any) -> None:
+        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            for f in dataclasses.fields(obj):
+                _walk(f"{prefix}{f.name}.", getattr(obj, f.name))
+        else:
+            out[prefix[:-1]] = obj
+
+    _walk("", cfg)
+    return out

@@ -1,0 +1,133 @@
+"""Seeded initial-pool and eval-split index generation.
+
+Re-implements src/utils/generate_initial_pool.py: ``random`` and
+``random_balance`` generation with the water-filling balanced allocation, the
+seed-99 eval split and seed-98 initial pool (wired in src/main_al.py:71,83).
+The water-filling helper is shared with BalancedRandomSampler
+(src/query_strategies/balanced_random_sampler.py:50-79), which uses the same
+algorithm.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def balanced_allocation(counts: np.ndarray, total: int) -> np.ndarray:
+    """Water-filling: per-class quota summing to ``total``, as balanced as the
+    per-class availability allows.
+
+    Equivalent to the threshold-search loops at
+    src/utils/generate_initial_pool.py:31-56 and
+    src/query_strategies/balanced_random_sampler.py:50-79: every class
+    contributes min(count, thres) and the remainder is distributed one extra
+    each to the largest classes.
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    total = int(total)
+    if total > counts.sum():
+        raise ValueError(
+            f"requested {total} samples but only {counts.sum()} available")
+    order = np.argsort(counts, kind="stable")
+    sorted_counts = counts[order]
+
+    lo, hi = 0, int(sorted_counts.max(initial=0))
+    # Find the smallest threshold at which clipping yields >= total.
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if np.minimum(sorted_counts, mid).sum() >= total:
+            hi = mid
+        else:
+            lo = mid + 1
+    thres = lo
+    quota_sorted = np.minimum(sorted_counts, thres)
+    # Classes still above the threshold can give one more each; remove the
+    # surplus from the *smallest* of the at-threshold classes, i.e. hand the
+    # "+1" extras to the largest classes — matching the reference's
+    # ``num_classes_sample_count[-oneadd:] = thres + 1`` after an ascending
+    # sort (generate_initial_pool.py:51-53).
+    surplus = int(quota_sorted.sum() - total)
+    if surplus > 0:
+        at_thres = np.flatnonzero(quota_sorted == thres)
+        quota_sorted[at_thres[:surplus]] -= 1
+    quota = np.empty_like(quota_sorted)
+    quota[order] = quota_sorted
+    assert quota.sum() == total
+    assert (quota <= counts).all()
+    return quota
+
+
+def generate_idxs(
+    targets: Sequence[int],
+    num_classes: int,
+    size: int,
+    generation_type: str,
+    avoid_idxs: Optional[Sequence[int]] = None,
+    random_seed: Optional[int] = None,
+) -> np.ndarray:
+    """Select ``size`` indices uniformly ("random") or class-balanced
+    ("random_balance") from positions not in ``avoid_idxs``.
+
+    Mirrors src/utils/generate_initial_pool.py:8-70, including the quirk
+    that a non-divisible ``random_balance`` size is rounded down to a
+    multiple of ``num_classes`` (:21-24).
+    """
+    rng = np.random.default_rng(random_seed)
+    targets = np.asarray(targets, dtype=np.int64)
+    available = np.arange(len(targets))
+    if avoid_idxs is not None and len(avoid_idxs):
+        available = np.setdiff1d(available, np.asarray(avoid_idxs))
+
+    if generation_type == "random":
+        rng.shuffle(available)
+        return available[:size]
+
+    if generation_type == "random_balance":
+        if size % num_classes != 0:
+            size = size - size % num_classes
+        counts = np.bincount(targets[available], minlength=num_classes)
+        quota = balanced_allocation(counts, size)
+        rng.shuffle(available)
+        remaining = quota.copy()
+        result = []
+        for idx in available:
+            if size == 0:
+                break
+            y = targets[idx]
+            if remaining[y] > 0:
+                result.append(idx)
+                remaining[y] -= 1
+                size -= 1
+        return np.asarray(result, dtype=np.int64)
+
+    raise ValueError(f"Init pool type '{generation_type}' not implemented")
+
+
+def generate_eval_idxs(
+    targets: Sequence[int],
+    num_classes: int,
+    ratio: float = 0.1,
+    random_seed: Optional[int] = None,
+) -> np.ndarray:
+    """Class-balanced validation split (generate_initial_pool.py:72-75)."""
+    eval_size = int(len(targets) * ratio)
+    return generate_idxs(targets, num_classes, eval_size,
+                         generation_type="random_balance",
+                         random_seed=random_seed)
+
+
+def generate_init_lb_idxs(
+    targets: Sequence[int],
+    num_classes: int,
+    eval_idxs: Sequence[int],
+    init_pool_size: int,
+    init_pool_type: str = "random",
+    random_seed: Optional[int] = None,
+) -> np.ndarray:
+    """Round-0 labeled pool, avoiding the eval split
+    (generate_initial_pool.py:78-80)."""
+    return generate_idxs(targets, num_classes, init_pool_size,
+                         generation_type=init_pool_type,
+                         avoid_idxs=eval_idxs, random_seed=random_seed)

@@ -1,0 +1,146 @@
+"""Active-learning pool bookkeeping.
+
+Replaces the mask-bookkeeping spread across the reference's ``Strategy`` base
+class (``idxs_lb``/``idxs_lb_recent``/``eval_idxs``/``cumulative_cost`` and
+the methods ``available_query_idxs``/``already_labeled_idxs``/``update``,
+src/query_strategies/strategy.py:97-163,459-485) with an explicit, picklable
+dataclass.  All randomness is taken from an injected ``numpy`` Generator so
+runs are reproducible end-to-end (the reference relies on the global
+``np.random`` state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PoolState:
+    """Boolean-mask view of the unlabeled pool.
+
+    Attributes:
+      n_pool: total number of candidate examples (== len(al_set)).
+      labeled: bool[n_pool]; True where the example has been labeled.
+      recent: indices labeled by the most recent ``update`` call.
+      eval_idxs: validation indices carved out of the train set; never
+        queryable (strategy.py:138,144).
+      cumulative_cost: total budget spent so far.
+      round: current AL round.
+    """
+
+    n_pool: int
+    labeled: np.ndarray
+    eval_idxs: np.ndarray
+    recent: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, dtype=np.int64))
+    cumulative_cost: float = 0.0
+    round: int = 0
+
+    @classmethod
+    def create(cls, n_pool: int, eval_idxs: Sequence[int]) -> "PoolState":
+        return cls(
+            n_pool=int(n_pool),
+            labeled=np.zeros(n_pool, dtype=bool),
+            eval_idxs=np.asarray(eval_idxs, dtype=np.int64),
+        )
+
+    # -- queries ---------------------------------------------------------
+
+    def available_mask(self) -> np.ndarray:
+        """Bool mask of queryable examples: unlabeled and not in the eval
+        split (strategy.py:139-142)."""
+        mask = ~self.labeled
+        if self.eval_idxs.size:
+            mask[self.eval_idxs] = False
+        return mask
+
+    def available_query_idxs(
+        self,
+        shuffle: bool = True,
+        rng: Optional[np.random.Generator] = None,
+    ) -> np.ndarray:
+        """Indices of queryable examples, optionally shuffled
+        (strategy.py:143-145: shuffle precedes eval-idx filtering, so the
+        order is a permutation of the unlabeled set)."""
+        idxs = np.flatnonzero(self.available_mask())
+        if shuffle:
+            if rng is None:
+                raise ValueError("shuffle=True requires an explicit rng")
+            idxs = rng.permutation(idxs)
+        return idxs
+
+    def labeled_idxs(
+        self,
+        shuffle: bool = False,
+        rng: Optional[np.random.Generator] = None,
+    ) -> np.ndarray:
+        idxs = np.flatnonzero(self.labeled)
+        if shuffle:
+            if rng is None:
+                raise ValueError("shuffle=True requires an explicit rng")
+            idxs = rng.permutation(idxs)
+        return idxs
+
+    def labeled_mask(self) -> np.ndarray:
+        return self.labeled.copy()
+
+    @property
+    def num_labeled(self) -> int:
+        return int(self.labeled.sum())
+
+    @property
+    def num_available(self) -> int:
+        return int(self.available_mask().sum())
+
+    # -- mutation --------------------------------------------------------
+
+    def update(self, labeled_idxs: Sequence[int], cost: float) -> None:
+        """Mark ``labeled_idxs`` as labeled; add ``cost`` to the budget.
+
+        Enforces the reference's invariants (strategy.py:468-471): no
+        example may be labeled twice, and a query batch may not contain
+        duplicates.
+        """
+        idxs = np.asarray(labeled_idxs, dtype=np.int64).reshape(-1)
+        if idxs.size:
+            if idxs.min() < 0 or idxs.max() >= self.n_pool:
+                raise ValueError(
+                    f"indices out of range [0, {self.n_pool}): "
+                    f"{idxs[(idxs < 0) | (idxs >= self.n_pool)][:10].tolist()}")
+            if np.unique(idxs).size != idxs.size:
+                raise ValueError("query returned duplicate indices")
+            if self.labeled[idxs].any():
+                dup = idxs[self.labeled[idxs]][:10]
+                raise ValueError(
+                    f"examples already labeled: {dup.tolist()}")
+            if self.eval_idxs.size and np.isin(idxs, self.eval_idxs).any():
+                raise ValueError("query returned validation indices")
+            self.labeled[idxs] = True
+        self.recent = idxs
+        self.cumulative_cost += float(cost)
+
+    # -- (de)serialization ----------------------------------------------
+
+    def to_arrays(self) -> dict:
+        return {
+            "n_pool": np.asarray(self.n_pool),
+            "labeled": self.labeled.copy(),
+            "eval_idxs": self.eval_idxs.copy(),
+            "recent": self.recent.copy(),
+            "cumulative_cost": np.asarray(self.cumulative_cost),
+            "round": np.asarray(self.round),
+        }
+
+    @classmethod
+    def from_arrays(cls, arrs: dict) -> "PoolState":
+        return cls(
+            n_pool=int(arrs["n_pool"]),
+            labeled=np.array(arrs["labeled"], dtype=bool, copy=True),
+            eval_idxs=np.array(arrs["eval_idxs"], dtype=np.int64, copy=True),
+            recent=np.array(arrs["recent"], dtype=np.int64, copy=True),
+            cumulative_cost=float(arrs["cumulative_cost"]),
+            round=int(arrs["round"]),
+        )

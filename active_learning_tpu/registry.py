@@ -1,0 +1,63 @@
+"""Explicit name->factory registries.
+
+The reference resolves strategies, optimizers, schedulers, and metrics from
+strings via ``eval()`` (src/query_strategies/get_strategy.py:17,
+src/query_strategies/strategy.py:345-350, src/utils/evaluation.py:103) and
+imports arg pools via ``exec()`` (src/main_al.py:48).  This module replaces
+all of that with typed registries.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, Iterator, TypeVar
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: Dict[str, T] = {}
+
+    def register(self, name: str, obj: T = None):
+        """Register ``obj`` under ``name``; usable as a decorator."""
+        if obj is not None:
+            self._add(name, obj)
+            return obj
+
+        def deco(o: T) -> T:
+            self._add(name, o)
+            return o
+
+        return deco
+
+    def _add(self, name: str, obj: T) -> None:
+        if name in self._entries:
+            raise KeyError(f"{self.kind} '{name}' already registered")
+        self._entries[name] = obj
+
+    def get(self, name: str) -> T:
+        try:
+            return self._entries[name]
+        except KeyError:
+            known = ", ".join(sorted(self._entries))
+            raise KeyError(
+                f"Unknown {self.kind} '{name}'. Known: {known}") from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(sorted(self._entries))
+
+    def names(self):
+        return sorted(self._entries)
+
+
+# Global registries, populated by the defining modules on import.
+STRATEGIES: Registry = Registry("strategy")        # name -> Strategy subclass
+MODELS: Registry = Registry("model")               # name -> model factory
+DATASETS: Registry = Registry("dataset")           # name -> dataset-triple factory
+ARG_POOLS: Registry = Registry("arg_pool")         # name -> {dataset: TrainConfig}
+OPTIMIZERS: Registry = Registry("optimizer")       # name -> optax factory
+SCHEDULERS: Registry = Registry("scheduler")       # name -> per-epoch lr fn factory

@@ -1,0 +1,44 @@
+"""Logger setup: "ActiveLearning" logger to file + console with
+millisecond timestamps (reference: src/utils/setup_logging.py)."""
+
+from __future__ import annotations
+
+import datetime as dt
+import logging
+import os
+
+LOGGER_NAME = "ActiveLearning"
+
+
+class MillisecondFormatter(logging.Formatter):
+    converter = dt.datetime.fromtimestamp
+
+    def formatTime(self, record, datefmt=None):
+        ct = self.converter(record.created)
+        if datefmt:
+            return ct.strftime(datefmt)
+        t = ct.strftime("%Y-%m-%d %H:%M:%S")
+        return "%s,%03d" % (t, record.msecs)
+
+
+def get_logger() -> logging.Logger:
+    return logging.getLogger(LOGGER_NAME)
+
+
+def setup_logging(directory: str, filename: str) -> logging.Logger:
+    os.makedirs(directory, exist_ok=True)
+    logger = logging.getLogger(LOGGER_NAME)
+    logger.setLevel(logging.INFO)
+    # Idempotent: clear handlers so repeated setup (tests, resume) doesn't
+    # duplicate output lines.
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    formatter = MillisecondFormatter(
+        fmt="%(asctime)s %(message)s", datefmt="%Y-%m-%d,%H:%M:%S.%f")
+    file_handler = logging.FileHandler(
+        filename=os.path.join(directory, filename), mode="w+")
+    file_handler.setFormatter(formatter)
+    logger.addHandler(file_handler)
+    console_handler = logging.StreamHandler()
+    logger.addHandler(console_handler)
+    return logger

@@ -1,0 +1,116 @@
+"""Pluggable experiment-metrics sink.
+
+Replaces the reference's Comet ML integration (src/main_al.py:101-114 and the
+``comet_experiment.log_metrics``/``log_asset_data`` calls threaded through
+``Strategy``) with a local JSONL sink that records the same metric schema:
+``cumulative_budget``, ``rd_test_accuracy``, ``budget_test_accuracy``,
+``rd_{n}_validation_accuracy``, per-class accuracy assets, and queried-index
+assets (metric names documented at src/main_al.py:24-40).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, Iterable, Optional
+
+
+class MetricsSink:
+    """Abstract sink.  ``step`` mirrors comet's step argument (round, epoch,
+    or cumulative budget depending on the metric)."""
+
+    def log_parameters(self, params: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def log_metrics(self, metrics: Dict[str, float], step: Optional[float] = None) -> None:
+        raise NotImplementedError
+
+    def log_metric(self, name: str, value: float, step: Optional[float] = None) -> None:
+        self.log_metrics({name: value}, step=step)
+
+    def log_asset(self, name: str, data: str) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class NullSink(MetricsSink):
+    """Disabled metrics (reference: ``--enable_comet`` off =>
+    ``disabled=True`` experiment, main_al.py:102)."""
+
+    def log_parameters(self, params):  # noqa: D102
+        pass
+
+    def log_metrics(self, metrics, step=None):  # noqa: D102
+        pass
+
+    def log_asset(self, name, data):  # noqa: D102
+        pass
+
+
+class JsonlSink(MetricsSink):
+    """Append-only JSONL event stream under ``directory``.
+
+    Events: {"kind": "params"|"metric"|"asset", "ts": ..., ...}.  Assets are
+    written both inline and as separate files under ``assets/`` so the
+    queried-index audit trail survives like the reference's
+    ``labeled_idxs_per_round.txt`` (strategy.py:480-483).
+    """
+
+    def __init__(self, directory: str, experiment_key: Optional[str] = None):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        os.makedirs(os.path.join(directory, "assets"), exist_ok=True)
+        self.experiment_key = experiment_key or uuid.uuid4().hex[:9]
+        self._path = os.path.join(directory, "metrics.jsonl")
+        self._fh = open(self._path, "a")
+
+    def _emit(self, event: Dict[str, Any]) -> None:
+        event["ts"] = time.time()
+        self._fh.write(json.dumps(event, default=_json_default) + "\n")
+        self._fh.flush()
+
+    def log_parameters(self, params):
+        self._emit({"kind": "params", "params": params})
+
+    def log_metrics(self, metrics, step=None):
+        self._emit({"kind": "metric", "step": step,
+                    "metrics": {k: _to_float(v) for k, v in metrics.items()}})
+
+    def log_asset(self, name, data):
+        path = os.path.join(self.directory, "assets", f"{name}.txt")
+        with open(path, "w") as fh:
+            fh.write(data)
+        self._emit({"kind": "asset", "name": name, "path": path})
+
+    def close(self):
+        self._fh.close()
+
+
+def _to_float(v: Any) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+def _json_default(o: Any):
+    try:
+        import numpy as np
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        if isinstance(o, np.generic):
+            return o.item()
+    except ImportError:
+        pass
+    return str(o)
+
+
+def make_sink(enable: bool, directory: str,
+              experiment_key: Optional[str] = None) -> MetricsSink:
+    if not enable:
+        return NullSink()
+    return JsonlSink(directory, experiment_key=experiment_key)

@@ -1,0 +1,80 @@
+"""Tests for seeded initial-pool / eval-split generation
+(reference: src/utils/generate_initial_pool.py)."""
+
+import numpy as np
+import pytest
+
+from active_learning_tpu.initial_pool import (
+    balanced_allocation,
+    generate_eval_idxs,
+    generate_idxs,
+    generate_init_lb_idxs,
+)
+
+
+def test_balanced_allocation_even():
+    quota = balanced_allocation(np.array([100, 100, 100, 100]), 40)
+    np.testing.assert_array_equal(quota, [10, 10, 10, 10])
+
+
+def test_balanced_allocation_scarce_class():
+    # Class 0 only has 3: water-filling gives it all 3, the rest split 37.
+    quota = balanced_allocation(np.array([3, 100, 100, 100]), 40)
+    assert quota[0] == 3
+    assert quota.sum() == 40
+    assert quota[1:].max() - quota[1:].min() <= 1
+
+
+def test_balanced_allocation_extras_go_to_largest():
+    # total=7 over counts [5,5,3]: thres=2 gives 2+2+2=6, one extra goes to
+    # a largest class (matching generate_initial_pool.py:51-53).
+    quota = balanced_allocation(np.array([5, 5, 3]), 7)
+    assert quota.sum() == 7
+    assert quota[2] == 2
+    assert sorted(quota[:2].tolist()) == [2, 3]
+
+
+def test_balanced_allocation_overdraw_raises():
+    with pytest.raises(ValueError):
+        balanced_allocation(np.array([1, 1]), 3)
+
+
+def test_generate_random_is_seeded_and_avoids():
+    targets = np.zeros(100, dtype=int)
+    avoid = np.arange(50)
+    a = generate_idxs(targets, 1, 20, "random", avoid_idxs=avoid, random_seed=7)
+    b = generate_idxs(targets, 1, 20, "random", avoid_idxs=avoid, random_seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert (a >= 50).all()
+    assert len(a) == 20
+
+
+def test_generate_balance_rounds_down_nondivisible():
+    targets = np.repeat(np.arange(10), 50)
+    out = generate_idxs(targets, 10, 57, "random_balance", random_seed=0)
+    # 57 -> 50 (multiple of num_classes), 5 per class
+    assert len(out) == 50
+    counts = np.bincount(targets[out], minlength=10)
+    np.testing.assert_array_equal(counts, [5] * 10)
+
+
+def test_eval_and_init_pool_disjoint():
+    targets = np.repeat(np.arange(10), 100)
+    eval_idxs = generate_eval_idxs(targets, 10, ratio=0.1, random_seed=99)
+    init = generate_init_lb_idxs(targets, 10, eval_idxs, 200,
+                                 init_pool_type="random", random_seed=98)
+    assert len(np.intersect1d(eval_idxs, init)) == 0
+    assert len(init) == 200
+
+
+def test_balanced_init_pool_is_balanced():
+    targets = np.repeat(np.arange(10), 100)
+    init = generate_init_lb_idxs(targets, 10, np.array([]), 100,
+                                 init_pool_type="random_balance", random_seed=98)
+    counts = np.bincount(targets[init], minlength=10)
+    np.testing.assert_array_equal(counts, [10] * 10)
+
+
+def test_unknown_type_raises():
+    with pytest.raises(ValueError):
+        generate_idxs(np.zeros(10, dtype=int), 1, 5, "bogus")

@@ -1,0 +1,89 @@
+"""Tests for PoolState invariants (the reference's runtime asserts at
+src/query_strategies/strategy.py:470 and the duplicate-query asserts become
+real tests here, per SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from active_learning_tpu.pool import PoolState
+
+
+def make_pool(n=20, eval_idxs=(15, 16, 17)):
+    return PoolState.create(n, np.array(eval_idxs))
+
+
+def test_initial_state():
+    p = make_pool()
+    assert p.num_labeled == 0
+    assert p.num_available == 17  # 20 - 3 eval
+    assert p.cumulative_cost == 0
+
+
+def test_update_marks_labeled_and_cost():
+    p = make_pool()
+    p.update([0, 1, 2], cost=3)
+    assert p.num_labeled == 3
+    assert p.cumulative_cost == 3
+    assert set(p.recent.tolist()) == {0, 1, 2}
+    assert not p.available_mask()[[0, 1, 2]].any()
+
+
+def test_update_rejects_double_labeling():
+    p = make_pool()
+    p.update([0, 1], cost=2)
+    with pytest.raises(ValueError, match="already labeled"):
+        p.update([1, 2], cost=2)
+
+
+def test_update_rejects_duplicates():
+    p = make_pool()
+    with pytest.raises(ValueError, match="duplicate"):
+        p.update([3, 3], cost=2)
+
+
+def test_update_rejects_out_of_range():
+    p = make_pool()
+    with pytest.raises(ValueError, match="out of range"):
+        p.update([-5], cost=1)
+    with pytest.raises(ValueError, match="out of range"):
+        p.update([20], cost=1)
+
+
+def test_snapshot_does_not_alias_live_state():
+    p = make_pool()
+    snap = PoolState.from_arrays(p.to_arrays())
+    p.update([5], cost=1)
+    assert not snap.labeled[5]
+
+
+def test_update_rejects_eval_idxs():
+    p = make_pool()
+    with pytest.raises(ValueError, match="validation"):
+        p.update([15], cost=1)
+
+
+def test_available_excludes_eval_and_labeled():
+    p = make_pool()
+    p.update([0, 5], cost=2)
+    avail = p.available_query_idxs(shuffle=False)
+    assert 0 not in avail and 5 not in avail
+    assert 15 not in avail and 16 not in avail
+    assert len(avail) == 15
+
+
+def test_shuffle_is_seeded():
+    p = make_pool()
+    a = p.available_query_idxs(shuffle=True, rng=np.random.default_rng(1))
+    b = p.available_query_idxs(shuffle=True, rng=np.random.default_rng(1))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_round_trip_serialization():
+    p = make_pool()
+    p.update([0, 1], cost=2)
+    p.round = 3
+    q = PoolState.from_arrays(p.to_arrays())
+    assert q.round == 3
+    assert q.cumulative_cost == 2
+    np.testing.assert_array_equal(q.labeled, p.labeled)
+    np.testing.assert_array_equal(q.eval_idxs, p.eval_idxs)
