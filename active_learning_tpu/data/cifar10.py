@@ -1,0 +1,72 @@
+"""CIFAR-10 from disk (no network: the torchvision download path of the
+reference, custom_cifar10.py:30-33, is replaced by reading an existing
+``cifar-10-batches-py`` directory — the standard python-pickle layout).
+
+Produces the reference's dataset triple: augmented train view, plain al
+view over the same storage, and the test split
+(src/data_utils/custom_cifar10.py:28-40).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..registry import DATASETS
+from .core import ArrayDataset, CIFAR10_NORM, ViewSpec
+
+_TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+_TEST_FILES = ["test_batch"]
+
+
+def _load_batches(root: str, files) -> Tuple[np.ndarray, np.ndarray]:
+    images, targets = [], []
+    for fname in files:
+        path = os.path.join(root, fname)
+        with open(path, "rb") as fh:
+            entry = pickle.load(fh, encoding="latin1")
+        data = entry["data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        images.append(np.ascontiguousarray(data))
+        targets.extend(entry.get("labels", entry.get("fine_labels")))
+    return np.concatenate(images).astype(np.uint8), np.asarray(
+        targets, dtype=np.int64)
+
+
+def find_cifar10_root(data_path: str) -> str:
+    candidates = [data_path, os.path.join(data_path, "cifar-10-batches-py")]
+    for cand in candidates:
+        if cand and os.path.isfile(os.path.join(cand, "data_batch_1")):
+            return cand
+    raise FileNotFoundError(
+        f"CIFAR-10 python batches not found under '{data_path}'. Expected "
+        "'data_batch_1'..'data_batch_5' + 'test_batch' (the "
+        "cifar-10-batches-py layout). This environment has no network "
+        "egress, so the data must already be on disk; use the 'synthetic' "
+        "dataset otherwise.")
+
+
+def load_cifar10_arrays(data_path: str):
+    root = find_cifar10_root(data_path)
+    train = _load_batches(root, _TRAIN_FILES)
+    test = _load_batches(root, _TEST_FILES)
+    return train, test
+
+
+def get_data_cifar10(data_path: str, debug_mode: bool = False, **_unused):
+    (tr_images, tr_targets), (te_images, te_targets) = load_cifar10_arrays(
+        data_path)
+    limit = 50 if debug_mode else None
+    train_view = ViewSpec(CIFAR10_NORM, augment=True, pad=4)
+    val_view = ViewSpec(CIFAR10_NORM, augment=False)
+
+    train_set = ArrayDataset(tr_images, tr_targets, 10, train_view,
+                             limit=limit)
+    al_set = train_set.with_view(val_view)
+    test_set = ArrayDataset(te_images, te_targets, 10, val_view, limit=limit)
+    return train_set, test_set, al_set
+
+
+DATASETS.register("cifar10", get_data_cifar10)

@@ -1,0 +1,111 @@
+"""Host-side batching pipeline.
+
+Replaces the reference's torch DataLoader + DistributedSampler stack
+(strategy.py:308-328): here the "sampler" is explicit index math, batches
+are fixed-shape (the last batch is padded and masked — XLA wants static
+shapes), and a background prefetcher overlaps host gather/decode with device
+compute (the reference's num_workers/prefetch_factor,
+arg_pools/default.py:29-38).
+
+Every batch carries the example indices, preserving the reference's
+``(x, y, index)`` dataset contract (custom_cifar10.py:23-25) that lets
+acquisition scores map back to pool indices.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from .core import Dataset
+
+
+def batch_index_lists(idxs: np.ndarray, batch_size: int,
+                      shuffle: bool = False,
+                      rng: Optional[np.random.Generator] = None,
+                      drop_last: bool = False):
+    """Split ``idxs`` into per-batch index arrays."""
+    idxs = np.asarray(idxs)
+    if shuffle:
+        if rng is None:
+            raise ValueError("shuffle=True requires an explicit rng")
+        idxs = rng.permutation(idxs)
+    n = len(idxs)
+    if drop_last:
+        n = (n // batch_size) * batch_size
+    return [idxs[i:i + batch_size] for i in range(0, n, batch_size)]
+
+
+def gather_batch(dataset: Dataset, batch_idxs: np.ndarray,
+                 batch_size: int) -> Dict[str, np.ndarray]:
+    """Gather one fixed-shape batch: uint8 images + labels + pool indices +
+    validity mask (0.0 on padding rows)."""
+    actual = len(batch_idxs)
+    images = dataset.gather(batch_idxs)
+    labels = dataset.targets[batch_idxs]
+    mask = np.ones(actual, dtype=np.float32)
+    if actual < batch_size:
+        pad = batch_size - actual
+        images = np.concatenate(
+            [images, np.repeat(images[:1], pad, axis=0)], axis=0)
+        labels = np.concatenate([labels, np.repeat(labels[:1], pad)], axis=0)
+        batch_idxs = np.concatenate(
+            [batch_idxs, np.repeat(batch_idxs[:1], pad)], axis=0)
+        mask = np.concatenate([mask, np.zeros(pad, dtype=np.float32)], axis=0)
+    return {"image": images, "label": labels.astype(np.int32),
+            "index": np.asarray(batch_idxs, dtype=np.int32), "mask": mask}
+
+
+def iterate_batches(
+    dataset: Dataset,
+    idxs: np.ndarray,
+    batch_size: int,
+    shuffle: bool = False,
+    rng: Optional[np.random.Generator] = None,
+    drop_last: bool = False,
+    prefetch: int = 2,
+    num_threads: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Yield fixed-shape host batches, optionally prefetched on a
+    background thread (num_threads > 0).  Thread prefetch matters for
+    disk-backed datasets where ``gather`` decodes images."""
+    batches = batch_index_lists(idxs, batch_size, shuffle=shuffle, rng=rng,
+                                drop_last=drop_last)
+    if num_threads <= 0:
+        for b in batches:
+            yield gather_batch(dataset, b, batch_size)
+        return
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for b in batches:
+                if stop.is_set():
+                    return
+                q.put(gather_batch(dataset, b, batch_size))
+        except BaseException as e:  # surface errors on the consumer side
+            q.put(e)
+        finally:
+            q.put(None)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
+def num_batches(n: int, batch_size: int, drop_last: bool = False) -> int:
+    return n // batch_size if drop_last else -(-n // batch_size)

@@ -1,0 +1,61 @@
+"""Synthetic learnable dataset.
+
+The environment has zero network egress, so CIFAR/ImageNet can only be used
+when already on disk.  This dataset generates class-structured images
+(per-class template + noise) so end-to-end AL runs, tests, and benchmarks
+exercise real learning dynamics without any downloads.  It plays the role of
+the reference's ``--debug_mode`` tiny datasets (src/utils/parser.py:70-71)
+but with controllable size/shape/class count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..registry import DATASETS
+from .core import ArrayDataset, Normalization, ViewSpec
+
+SYNTH_NORM = Normalization((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
+
+
+def _make_images(n: int, num_classes: int, hw: int, rng: np.random.Generator
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    targets = rng.integers(0, num_classes, size=n)
+    templates = rng.uniform(40, 215, size=(num_classes, hw, hw, 3))
+    noise = rng.normal(0, 25, size=(n, hw, hw, 3))
+    images = np.clip(templates[targets] + noise, 0, 255).astype(np.uint8)
+    return images, targets.astype(np.int64)
+
+
+def get_data_synthetic(
+    data_path: Optional[str] = None,
+    n_train: int = 512,
+    n_test: int = 128,
+    num_classes: int = 10,
+    image_size: int = 32,
+    seed: int = 1234,
+    debug_mode: bool = False,
+    **_unused,
+):
+    """Build the (train_set, test_set, al_set) triple over shared storage,
+    mirroring the reference's dataset-triple contract
+    (src/data_utils/custom_cifar10.py:28-40)."""
+    rng = np.random.default_rng(seed)
+    tr_images, tr_targets = _make_images(n_train, num_classes, image_size, rng)
+    te_images, te_targets = _make_images(n_test, num_classes, image_size, rng)
+    limit = 50 if debug_mode else None
+
+    train_view = ViewSpec(SYNTH_NORM, augment=True, pad=4)
+    val_view = ViewSpec(SYNTH_NORM, augment=False)
+
+    train_set = ArrayDataset(tr_images, tr_targets, num_classes, train_view,
+                             limit=limit)
+    al_set = train_set.with_view(val_view)
+    test_set = ArrayDataset(te_images, te_targets, num_classes, val_view,
+                            limit=limit)
+    return train_set, test_set, al_set
+
+
+DATASETS.register("synthetic", get_data_synthetic)

@@ -1,0 +1,49 @@
+"""Model factory: dataset/model-name -> SSLClassifier.
+
+Mirrors src/utils/get_networks.py (MODEL_ARGS/DATA_ARGS tables and
+``get_networks(dataset, model)``), with the CIFAR stem driven explicitly by
+the dataset's class count like the reference's ``num_classes == 10`` trigger
+(resnet_simclr.py:17-18).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..registry import MODELS
+from .resnet import SSLClassifier, resnet18, resnet50
+
+MODELS.register("SSLResNet18", resnet18)
+MODELS.register("SSLResNet50", resnet50)
+
+# Dataset -> class count (get_networks.py:3-6).
+DATASET_NUM_CLASSES = {
+    "cifar10": 10,
+    "imbalanced_cifar10": 10,
+    "imagenet": 1000,
+    "imbalanced_imagenet": 1000,
+    "synthetic": 10,
+}
+
+
+def get_network(
+    dataset: str,
+    model_name: str,
+    freeze_feature: bool = False,
+    num_classes: Optional[int] = None,
+    dtype: Any = jnp.float32,
+) -> SSLClassifier:
+    if num_classes is None:
+        try:
+            num_classes = DATASET_NUM_CLASSES[dataset]
+        except KeyError:
+            raise KeyError(
+                f"Unknown dataset '{dataset}'; pass num_classes explicitly")
+    factory = MODELS.get(model_name)
+    # The reference applies the SimCLR CIFAR stem whenever num_classes == 10
+    # (resnet_simclr.py:17-18); keep that behavior.
+    cifar_stem = num_classes == 10
+    return factory(num_classes=num_classes, cifar_stem=cifar_stem,
+                   freeze_feature=freeze_feature, dtype=dtype)

@@ -1,0 +1,213 @@
+"""TPU-native (NHWC, Flax) ResNet-18/50 with the SimCLR CIFAR stem and a
+split encoder / linear-classification head.
+
+Capability parity with the reference's model stack:
+  * torchvision resnet18/50 v1.5 topology wrapped by ``ResNetSimCLR``
+    (src/models/resnet_simclr.py:6-41): encoder with ``fc`` removed plus a
+    separate ``linear`` head.
+  * SimCLR CIFAR stem modification — 3x3 stride-1 first conv, no max pool —
+    applied when the dataset is CIFAR (src/models/resnet_hacks.py:31-35,
+    triggered at resnet_simclr.py:17-18).
+  * Three forward modes (resnet_simclr.py:29-41): plain logits,
+    ``return_features`` (logits + final embedding), and head-only from an
+    embedding (``specify_input_layer='finalembed'``) — here the explicit
+    ``head`` method.
+  * ``freeze_feature`` detaches the embedding (resnet_simclr.py:36-37) —
+    here ``jax.lax.stop_gradient``.
+
+Design notes (TPU-first, not a translation):
+  * NHWC layout — XLA's native conv layout on TPU; convs tile directly onto
+    the MXU.
+  * ``dtype`` controls the compute precision (bfloat16 on TPU); parameters
+    and batch-norm statistics stay float32.
+  * Global-batch BatchNorm: under ``jit`` over a data-sharded mesh the batch
+    reduction lowers to a cross-replica collective automatically, giving
+    SyncBatchNorm semantics (reference: strategy.py:292) with no special
+    wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+# torch init_params semantics (src/models/utils.py:5-18): conv weights
+# kaiming-normal fan_out, linear weights N(0, 1e-3), biases zero.  BatchNorm
+# scale=1/bias=0 is the flax default.
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+dense_kernel_init = nn.initializers.normal(stddev=1e-3)
+
+
+class BasicBlock(nn.Module):
+    """ResNet v1.5 basic block (two 3x3 convs) — resnet18/34."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = None
+    norm: ModuleDef = None
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.ones)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """ResNet v1.5 bottleneck (1x1 -> strided 3x3 -> 1x1 x4) — resnet50.
+
+    The stride lives on the 3x3 conv, matching torchvision's v1.5 used by
+    the reference (resnet_hacks.py docstring notes torchvision is v1.5).
+    """
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = None
+    norm: ModuleDef = None
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.ones)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNetEncoder(nn.Module):
+    """Backbone producing the pooled final embedding (fc removed, mirroring
+    ``self.encoder.fc = nn.Identity()`` at resnet_simclr.py:21)."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_filters: int = 64
+    cifar_stem: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype,
+            kernel_init=conv_kernel_init)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, axis_name=None)
+
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            # SimCLR CIFAR stem: 3x3 stride-1 conv, no max pool
+            # (resnet_hacks.py:31-35).
+            x = conv(self.num_filters, (3, 3), (1, 1), name="conv_stem")(x)
+            x = norm(name="bn_stem")(x)
+            x = nn.relu(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_stem")(x)
+            x = norm(name="bn_stem")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                            padding=[(1, 1), (1, 1)])
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2 ** i,
+                    strides=strides, conv=conv, norm=norm,
+                    name=f"stage{i + 1}_block{j}")(x)
+
+        # Global average pool -> final embedding, float32 for the head and
+        # for downstream acquisition math (margins, pairwise distances).
+        x = jnp.mean(x, axis=(1, 2))
+        return x.astype(jnp.float32)
+
+
+class SSLClassifier(nn.Module):
+    """Encoder + separate linear head (resnet_simclr.py:20-22).
+
+    Forward modes:
+      * ``apply(vars, x)``                      -> logits
+      * ``apply(vars, x, return_features=True)``-> (logits, embedding)
+      * ``apply(vars, emb, method="head")``     -> logits from an embedding
+        (the reference's ``specify_input_layer='finalembed'``).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int
+    cifar_stem: bool = False
+    freeze_feature: bool = False
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.encoder = ResNetEncoder(
+            stage_sizes=self.stage_sizes, block_cls=self.block_cls,
+            cifar_stem=self.cifar_stem, dtype=self.dtype, name="encoder")
+        self.linear = nn.Dense(
+            self.num_classes, kernel_init=dense_kernel_init,
+            bias_init=nn.initializers.zeros, name="linear")
+
+    def __call__(self, x, train: bool = True, return_features: bool = False):
+        embedding = self.encoder(x, train=train)
+        if self.freeze_feature:
+            # Stop-gradient on the backbone output (resnet_simclr.py:36-37);
+            # combined with eval-mode BN in the trainer this freezes the
+            # feature extractor for linear evaluation.
+            embedding = jax.lax.stop_gradient(embedding)
+        logits = self.linear(embedding)
+        if return_features:
+            return logits, embedding
+        return logits
+
+    def head(self, embedding):
+        return self.linear(embedding)
+
+    @property
+    def embed_dim(self) -> int:
+        mult = 4 if self.block_cls is BottleneckBlock else 1
+        return 64 * 2 ** (len(self.stage_sizes) - 1) * mult
+
+
+def _make(stage_sizes, block_cls, num_classes, cifar_stem, freeze_feature,
+          dtype):
+    return SSLClassifier(
+        stage_sizes=tuple(stage_sizes), block_cls=block_cls,
+        num_classes=num_classes, cifar_stem=cifar_stem,
+        freeze_feature=freeze_feature, dtype=dtype)
+
+
+def resnet18(num_classes: int, cifar_stem: bool = False,
+             freeze_feature: bool = False,
+             dtype: Any = jnp.float32) -> SSLClassifier:
+    return _make([2, 2, 2, 2], BasicBlock, num_classes, cifar_stem,
+                 freeze_feature, dtype)
+
+
+def resnet50(num_classes: int, cifar_stem: bool = False,
+             freeze_feature: bool = False,
+             dtype: Any = jnp.float32) -> SSLClassifier:
+    return _make([3, 4, 6, 3], BottleneckBlock, num_classes, cifar_stem,
+                 freeze_feature, dtype)

@@ -1,0 +1,76 @@
+"""Device mesh + sharding helpers.
+
+Replaces the reference's process-per-GPU DDP world (mp.spawn + NCCL process
+group per round, src/query_strategies/strategy.py:288-336) with ONE
+persistent JAX runtime and a `jax.sharding.Mesh`:
+
+  * 1-D ``data`` axis today (the reference's only parallelism is data
+    parallel, SURVEY.md §2), with the axis names kept open for model axes.
+  * Batches are sharded over ``data``; parameters are replicated.  Under
+    ``jit``'s automatic partitioning the gradient reduction and batch-norm
+    statistics lower to XLA collectives over ICI — the DDP allreduce
+    (strategy.py:336), metric all_gather (evaluation.py:69-98) and
+    SyncBatchNorm (strategy.py:292) all fall out of the sharding annotations.
+  * Multi-host pods: `initialize_distributed()` wires `jax.distributed`
+    over DCN; the mesh then spans all processes' devices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host init over DCN (no-op for single-process runs).
+
+    The TPU equivalent of the reference's NCCL rendezvous
+    (strategy.py:288-289,315) — but done once per run, not once per round.
+    """
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+
+def make_mesh(num_devices: int = -1,
+              devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """1-D data-parallel mesh over the first ``num_devices`` devices
+    (-1 = all).  Mirrors world_size = torch.cuda.device_count()
+    (main_al.py:96)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices == -1:
+        num_devices = len(devices)
+    devices = np.asarray(devices[:num_devices])
+    return Mesh(devices, (DATA_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) dimension split across the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh) -> Dict[str, Any]:
+    """Host batch -> device arrays with the batch axis sharded over the
+    mesh.  This is the host->device boundary (the reference's pinned-memory
+    H2D copies, strategy.py:264,328)."""
+    sharding = batch_sharding(mesh)
+    return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    sharding = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,52 @@
+"""Checkpoint I/O.
+
+Replaces the reference's ``torch.save(state_dict)`` per-round best/current
+checkpoints (src/query_strategies/strategy.py:425-440) and the whole-object
+pickle resume (src/utils/resume_training.py) with explicit artifacts:
+  * model variables (params + batch_stats) as msgpack (flax.serialization);
+  * experiment state (pool masks, round, rng, config echo) as npz + json —
+    see experiment/resume.py.
+
+Checkpoint paths follow the reference's layout
+(strategy.py:165-173): ``{ckpt_root}/{exp_name}_{exp_hash}/best_rd_{n}`` and
+``rd_{n}``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def save_variables(path: str, variables: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    host_vars = jax.tree.map(np.asarray, variables)
+    with open(path, "wb") as fh:
+        fh.write(serialization.msgpack_serialize(host_vars))
+
+
+def load_variables(path: str, like: Dict[str, Any] = None) -> Dict[str, Any]:
+    with open(path, "rb") as fh:
+        restored = serialization.msgpack_restore(fh.read())
+    if like is not None:
+        restored = serialization.from_state_dict(like, restored)
+    return restored
+
+
+def weight_paths(ckpt_root: str, exp_name: str, exp_hash: str,
+                 round_idx: int) -> Dict[str, str]:
+    """best/current/previous checkpoint paths for a round
+    (strategy.py:165-173; ``previous_ckpt`` kept for parity though the
+    reference never consumes it)."""
+    ckpt_dir = os.path.join(ckpt_root, f"{exp_name}_{exp_hash}")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    return {
+        "best_ckpt": os.path.join(ckpt_dir, f"best_rd_{round_idx}.msgpack"),
+        "previous_ckpt": os.path.join(ckpt_dir, f"rd_{round_idx - 1}.msgpack"),
+        "current_ckpt": os.path.join(ckpt_dir, f"rd_{round_idx}.msgpack"),
+        "dir": ckpt_dir,
+    }

@@ -1,0 +1,82 @@
+"""Jitted, mesh-sharded evaluation metrics.
+
+Replaces src/utils/evaluation.py: ``accuracy`` (top-1/top-5/per-class over a
+loader, :11-66) and ``gather_parallel_eval`` (NCCL all_gather of counts,
+:69-98).  On TPU the per-batch counts are computed in one jitted function
+over the sharded batch; the cross-device reduction is a by-product of the
+sharding (XLA inserts the collective), so there is no separate gather step.
+Final division happens on host once all batches are accumulated — identical
+math to the reference's corrects/count bookkeeping.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.augment import apply_view
+from ..data.core import ViewSpec
+
+
+def batch_metric_counts(logits: jnp.ndarray, labels: jnp.ndarray,
+                        mask: jnp.ndarray, num_classes: int,
+                        top_k: int = 5) -> Dict[str, jnp.ndarray]:
+    """Counts for one batch: top-1/top-k corrects, per-class corrects and
+    totals.  Padding rows (mask 0) contribute nothing."""
+    k = min(top_k, num_classes)
+    _, topk_pred = jax.lax.top_k(logits, k)
+    hit_topk = (topk_pred == labels[:, None]).any(axis=1)
+    top1 = topk_pred[:, 0] == labels
+    maskf = mask.astype(jnp.float32)
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32) * maskf[:, None]
+    return {
+        "top_1_correct": jnp.sum(top1 * maskf),
+        "top_k_correct": jnp.sum(hit_topk * maskf),
+        "corrects_byclass": jnp.sum(onehot * (top1 * maskf)[:, None], axis=0),
+        "count_byclass": jnp.sum(onehot, axis=0),
+        "count": jnp.sum(maskf),
+    }
+
+
+def make_eval_step(model, view: ViewSpec, num_classes: int):
+    """Jitted: uint8 batch -> metric counts.  The batch arrives sharded over
+    the mesh's data axis; XLA reduces the counts across devices."""
+
+    @jax.jit
+    def eval_step(variables, batch):
+        x = apply_view(batch["image"], view, train=False)
+        logits = model.apply(variables, x, train=False)
+        return batch_metric_counts(logits, batch["label"], batch["mask"],
+                                   num_classes)
+
+    return eval_step
+
+
+def accumulate_metrics(count_iter: Iterator[Dict[str, jnp.ndarray]]
+                       ) -> Dict[str, np.ndarray]:
+    """Sum per-batch counts and derive the reference's metric dict keys
+    (evaluation.py:58-66): accuracy, top_5_accuracy, accuracy_byclass,
+    corrects_byclass, count_byclass, count."""
+    totals: Optional[Dict[str, np.ndarray]] = None
+    for counts in count_iter:
+        counts = {k: np.asarray(v) for k, v in counts.items()}
+        if totals is None:
+            totals = counts
+        else:
+            totals = {k: totals[k] + counts[k] for k in totals}
+    assert totals is not None, "no eval batches"
+    count = max(totals["count"], 1.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        byclass = totals["corrects_byclass"] / totals["count_byclass"]
+    return {
+        "accuracy": totals["top_1_correct"] / count,
+        "top_5_accuracy": totals["top_k_correct"] / count,
+        "accuracy_byclass": byclass,
+        "corrects_byclass": totals["corrects_byclass"],
+        "count_byclass": totals["count_byclass"],
+        "count": count,
+    }

@@ -1,0 +1,79 @@
+"""Optimizers and per-epoch LR schedules.
+
+Replaces the reference's string-``eval`` optimizer/scheduler construction
+(src/query_strategies/strategy.py:345-350) with explicit optax factories.
+
+Semantics preserved:
+  * torch ``SGD(lr, momentum, weight_decay)``: grad += wd * p, then
+    heavy-ball momentum, then p -= lr * buf — optax chain
+    ``add_decayed_weights -> trace -> scale(-lr)``.
+  * Schedulers step once per EPOCH (``scheduler.step()`` at strategy.py:369):
+    ``StepLR(step_size, gamma)`` and ``CosineAnnealingLR(T_max)``
+    (arg_pools/default.py:41-42, ssp_finetuning.py:31-33).  The trainer
+    computes ``lr_at_epoch(epoch)`` on host and feeds the scalar into the
+    jitted step — no recompilation, exact per-epoch semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import optax
+
+from ..config import OptimizerConfig, SchedulerConfig
+from ..registry import OPTIMIZERS, SCHEDULERS
+
+
+def _sgd(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    parts = []
+    if cfg.weight_decay:
+        parts.append(optax.add_decayed_weights(cfg.weight_decay))
+    if cfg.momentum:
+        parts.append(optax.trace(decay=cfg.momentum, nesterov=False))
+    return optax.chain(*parts) if parts else optax.identity()
+
+
+def _adam(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    return optax.scale_by_adam()
+
+
+OPTIMIZERS.register("sgd", _sgd)
+OPTIMIZERS.register("SGD", _sgd)  # reference spelling (arg pools use "SGD")
+OPTIMIZERS.register("adam", _adam)
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    """Learning-rate-agnostic transform; the lr is applied in the train step
+    as ``updates * -lr`` so the host-side schedule stays exact."""
+    return OPTIMIZERS.get(cfg.name)(cfg)
+
+
+def _step_lr(cfg: SchedulerConfig, base_lr: float) -> Callable[[int], float]:
+    def lr_at(epoch0: int) -> float:
+        return base_lr * cfg.gamma ** (epoch0 // cfg.step_size)
+    return lr_at
+
+
+def _cosine_lr(cfg: SchedulerConfig, base_lr: float) -> Callable[[int], float]:
+    def lr_at(epoch0: int) -> float:
+        return base_lr * (1 + math.cos(math.pi * epoch0 / cfg.t_max)) / 2
+    return lr_at
+
+
+def _constant_lr(cfg: SchedulerConfig, base_lr: float) -> Callable[[int], float]:
+    return lambda epoch0: base_lr
+
+
+SCHEDULERS.register("step", _step_lr)
+SCHEDULERS.register("StepLR", _step_lr)
+SCHEDULERS.register("cosine", _cosine_lr)
+SCHEDULERS.register("CosineAnnealingLR", _cosine_lr)
+SCHEDULERS.register("constant", _constant_lr)
+
+
+def make_lr_schedule(cfg: SchedulerConfig, base_lr: float
+                     ) -> Callable[[int], float]:
+    """Returns lr_at(epoch0) where epoch0 is the number of completed
+    scheduler steps (torch: epoch 1 trains at base_lr, i.e. lr_at(0))."""
+    return SCHEDULERS.get(cfg.name)(cfg, base_lr)

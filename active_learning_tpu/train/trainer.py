@@ -1,0 +1,300 @@
+"""Per-round training engine.
+
+Replaces the reference's ``Strategy.train`` / ``parallel_train_fn`` /
+``_train`` / ``validation_and_early_stopping`` stack
+(src/query_strategies/strategy.py:249-442).  Key differences by design:
+
+  * ONE persistent JAX runtime for the whole experiment — no per-round
+    ``mp.spawn`` + NCCL process-group setup (strategy.py:288-315).  The
+    mesh exists once; each round just re-runs the jitted step.
+  * The train step is a single jitted function over a data-sharded batch:
+    gradient psum (DDP allreduce, strategy.py:336), global-batch BN stats
+    (SyncBatchNorm, strategy.py:292), and the fused normalize/augment all
+    come out of XLA's partitioner.
+  * BN-freeze semantics preserved: the reference trains with the network in
+    eval() mode whenever features are frozen OR a pretrained checkpoint is
+    configured (strategy.py:366-367) — here ``train_bn=False`` selects
+    running-average BN with no stats update while gradients still flow.
+  * Early stopping keeps the best parameters both on disk (best_rd_{n},
+    strategy.py:425-430) and in memory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from ..config import TrainConfig
+from ..data.augment import apply_view
+from ..data.core import Dataset
+from ..data.pipeline import iterate_batches
+from ..parallel import mesh as mesh_lib
+from ..utils.logging import get_logger
+from . import checkpoint as ckpt_lib
+from .evaluation import accumulate_metrics, make_eval_step
+from .optim import make_lr_schedule, make_optimizer
+
+
+class TrainState(struct.PyTreeNode):
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+    @property
+    def variables(self) -> Dict[str, Any]:
+        return {"params": self.params, "batch_stats": self.batch_stats}
+
+
+@dataclasses.dataclass
+class FitResult:
+    state: TrainState
+    best_epoch: int
+    best_perf: float
+    epochs_run: int
+    history: List[Dict[str, float]]
+
+
+def weighted_cross_entropy(logits, labels, sample_weights):
+    """torch ``CrossEntropyLoss(weight=w, reduction='mean')`` semantics:
+    sum(w_y * ce) / sum(w_y) (strategy.py:352-356); padding rows carry
+    weight 0."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ce = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
+                              axis=1)[:, 0]
+    denom = jnp.maximum(jnp.sum(sample_weights), 1e-12)
+    return jnp.sum(ce * sample_weights) / denom
+
+
+class Trainer:
+    """Owns the jitted train/eval steps for one (model, train-config) pair."""
+
+    def __init__(self, model, train_cfg: TrainConfig, mesh,
+                 num_classes: int, train_bn: Optional[bool] = None):
+        self.model = model
+        self.cfg = train_cfg
+        self.mesh = mesh
+        self.num_classes = num_classes
+        self.logger = get_logger()
+        self.tx = make_optimizer(train_cfg.optimizer)
+        self.lr_at = make_lr_schedule(train_cfg.scheduler,
+                                      train_cfg.optimizer.lr)
+        # Reference quirk (strategy.py:366-367): BN runs in eval mode during
+        # training whenever features are frozen or a pretrained ckpt is
+        # configured.
+        if train_bn is None:
+            train_bn = not (model.freeze_feature or train_cfg.has_pretrained)
+        self.train_bn = train_bn
+        self.n_devices = mesh.devices.size
+        self._train_step = self._build_train_step()
+        self._eval_steps: Dict[Any, Callable] = {}
+
+    # -- setup -----------------------------------------------------------
+
+    def padded_batch_size(self, batch_size: int) -> int:
+        """Round up so the batch axis divides evenly over the mesh; padding
+        rows are masked out of every reduction."""
+        n = self.n_devices
+        return -(-batch_size // n) * n
+
+    def init_state(self, rng: jax.Array, sample_input: np.ndarray
+                   ) -> TrainState:
+        variables = self.model.init(rng, jnp.asarray(sample_input),
+                                    train=False)
+        variables = mesh_lib.replicate(variables, self.mesh)
+        opt_state = self.tx.init(variables["params"])
+        opt_state = mesh_lib.replicate(opt_state, self.mesh)
+        return TrainState(params=variables["params"],
+                          batch_stats=variables.get("batch_stats", {}),
+                          opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+    def reinit_optimizer(self, state: TrainState) -> TrainState:
+        """Fresh optimizer state at the start of each round (the reference
+        constructs a new optimizer per round, strategy.py:345)."""
+        opt_state = mesh_lib.replicate(self.tx.init(state.params), self.mesh)
+        return state.replace(opt_state=opt_state,
+                             step=jnp.zeros((), jnp.int32))
+
+    def replace_variables(self, state: TrainState, variables) -> TrainState:
+        variables = mesh_lib.replicate(variables, self.mesh)
+        return state.replace(params=variables["params"],
+                             batch_stats=variables.get("batch_stats", {}))
+
+    # -- jitted steps ----------------------------------------------------
+
+    def _build_train_step(self):
+        model = self.model
+        tx = self.tx
+        train_bn = self.train_bn
+
+        def loss_fn(params, batch_stats, x, labels, weights):
+            variables = {"params": params, "batch_stats": batch_stats}
+            if train_bn:
+                logits, mutated = model.apply(
+                    variables, x, train=True, mutable=["batch_stats"])
+                new_stats = mutated["batch_stats"]
+            else:
+                logits = model.apply(variables, x, train=False)
+                new_stats = batch_stats
+            loss = weighted_cross_entropy(logits, labels, weights)
+            return loss, new_stats
+
+        @functools.partial(jax.jit, static_argnames=("view",),
+                           donate_argnums=(0,))
+        def train_step(state, batch, key, lr, class_weights, view):
+            x = apply_view(batch["image"], view, key=key, train=True)
+            weights = class_weights[batch["label"]] * batch["mask"]
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, state.batch_stats, x,
+                                       batch["label"], weights)
+            updates, new_opt_state = tx.update(grads, state.opt_state,
+                                               state.params)
+            updates = jax.tree.map(lambda u: -lr * u, updates)
+            params = optax.apply_updates(state.params, updates)
+            return state.replace(params=params, batch_stats=new_stats,
+                                 opt_state=new_opt_state,
+                                 step=state.step + 1), loss
+
+        return train_step
+
+    def _get_eval_step(self, view):
+        if view not in self._eval_steps:
+            self._eval_steps[view] = make_eval_step(
+                self.model, view, self.num_classes)
+        return self._eval_steps[view]
+
+    # -- class weights ---------------------------------------------------
+
+    def class_weights(self, labels: np.ndarray) -> np.ndarray:
+        """Imbalanced-training class weights (strategy.py:444-457):
+        observed classes get total/count, unobserved keep 1, normalized to
+        sum 1.  Identity (all ones) when imbalanced_training is off."""
+        if not self.cfg.imbalanced_training:
+            return np.ones(self.num_classes, dtype=np.float32)
+        uniq, counts = np.unique(labels, return_counts=True)
+        weights = np.ones(self.num_classes, dtype=np.float64)
+        weights[uniq] = counts.sum() / counts
+        weights /= weights.sum()
+        return weights.astype(np.float32)
+
+    # -- evaluation ------------------------------------------------------
+
+    def evaluate(self, state: TrainState, dataset: Dataset,
+                 idxs: np.ndarray) -> Dict[str, np.ndarray]:
+        """Top-1/top-5/per-class metrics over ``dataset[idxs]``
+        (replaces evaluation.py:11-105)."""
+        eval_step = self._get_eval_step(dataset.view)
+        bs = self.padded_batch_size(self.cfg.loader_te.batch_size)
+        variables = state.variables
+
+        def counts():
+            for batch in iterate_batches(
+                    dataset, idxs, bs,
+                    num_threads=self.cfg.loader_te.num_workers,
+                    prefetch=self.cfg.loader_te.prefetch):
+                yield eval_step(variables,
+                                mesh_lib.shard_batch(batch, self.mesh))
+
+        return accumulate_metrics(counts())
+
+    # -- the fit loop ----------------------------------------------------
+
+    def fit(
+        self,
+        state: TrainState,
+        train_set: Dataset,
+        labeled_idxs: np.ndarray,
+        al_set: Dataset,
+        eval_idxs: np.ndarray,
+        n_epoch: int,
+        es_patience: int,
+        rng: np.random.Generator,
+        round_idx: int = 0,
+        weight_paths: Optional[Dict[str, str]] = None,
+        metric_cb: Optional[Callable[[str, float, int], None]] = None,
+    ) -> FitResult:
+        """Train on the labeled subset with per-epoch validation + early
+        stopping (parallel_train_fn, strategy.py:304-381).
+
+        ``es_patience == 0`` disables early stopping (parser.py:66-69); in
+        that case the final parameters become the "best" (the reference
+        would crash in load_best_ckpt — deliberate fix)."""
+        use_es = es_patience != 0 and len(eval_idxs) > 0
+        labels = train_set.targets[labeled_idxs]
+        class_weights = jnp.asarray(self.class_weights(labels))
+        state = self.reinit_optimizer(state)
+        bs = self.padded_batch_size(self.cfg.loader_tr.batch_size)
+
+        best_perf, best_epoch, es_count = 0.0, 0, 0
+        best_variables = None
+        history: List[Dict[str, float]] = []
+        key = jax.random.PRNGKey(int(rng.integers(0, 2 ** 31 - 1)))
+
+        epochs_run = 0
+        for epoch in range(1, n_epoch + 1):
+            epochs_run = epoch
+            lr = jnp.float32(self.lr_at(epoch - 1))
+            losses = []
+            for batch in iterate_batches(
+                    train_set, labeled_idxs, bs, shuffle=True, rng=rng,
+                    num_threads=self.cfg.loader_tr.num_workers,
+                    prefetch=self.cfg.loader_tr.prefetch):
+                key, sub = jax.random.split(key)
+                state, loss = self._train_step(
+                    state, mesh_lib.shard_batch(batch, self.mesh), sub, lr,
+                    class_weights, view=train_set.view)
+                losses.append(loss)
+            epoch_loss = float(jnp.mean(jnp.stack(losses))) if losses else 0.0
+            record = {"epoch": epoch, "lr": float(lr),
+                      "train_loss": epoch_loss}
+
+            if use_es:
+                perf = self.evaluate(state, al_set, eval_idxs)
+                eval_acc = float(perf["accuracy"])
+                eval_top5 = float(perf["top_5_accuracy"])
+                record.update(val_accuracy=eval_acc, val_top5=eval_top5)
+                self.logger.info(
+                    f"\tValidation performance on round {round_idx} at "
+                    f"epoch {epoch} is {eval_acc * 100:.2f}%")
+                if metric_cb and epoch % 25 == 0:
+                    metric_cb(f"rd_{round_idx}_validation_accuracy",
+                              eval_acc, epoch)
+                    metric_cb(f"rd_{round_idx}_validation_top5_accuracy",
+                              eval_top5, epoch)
+                # >= : later epochs win ties (strategy.py:425-430).
+                if eval_acc >= best_perf:
+                    best_perf, best_epoch, es_count = eval_acc, epoch, 0
+                    best_variables = jax.tree.map(np.asarray,
+                                                  state.variables)
+                    if weight_paths:
+                        ckpt_lib.save_variables(weight_paths["best_ckpt"],
+                                                best_variables)
+                else:
+                    es_count += 1
+                if weight_paths:
+                    ckpt_lib.save_variables(weight_paths["current_ckpt"],
+                                            jax.tree.map(np.asarray,
+                                                         state.variables))
+            history.append(record)
+            if use_es and es_count > es_patience:
+                self.logger.info("Early stopping criterion reached. ")
+                break
+
+        if best_variables is None:
+            best_epoch = epochs_run
+            best_variables = jax.tree.map(np.asarray, state.variables)
+            if weight_paths:
+                ckpt_lib.save_variables(weight_paths["best_ckpt"],
+                                        best_variables)
+        self.logger.info(
+            f"Sanity Check: Best ckpt occurs on epoch {best_epoch}")
+        return FitResult(state=state, best_epoch=best_epoch,
+                         best_perf=best_perf, epochs_run=epochs_run,
+                         history=history)

@@ -1,0 +1,164 @@
+"""Data-layer tests: views, batching/padding, on-device augmentation,
+imbalance synthesis, disk datasets, prefetch."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from active_learning_tpu.config import ImbalanceConfig
+from active_learning_tpu.data import get_data
+from active_learning_tpu.data.augment import apply_view, random_crop_flip
+from active_learning_tpu.data.core import ArrayDataset, ViewSpec, CIFAR10_NORM
+from active_learning_tpu.data.imbalance import img_num_per_cls, imbalanced_indices
+from active_learning_tpu.data.pipeline import (batch_index_lists, gather_batch,
+                                               iterate_batches, num_batches)
+
+
+def test_synthetic_triple_shares_storage():
+    train, test, al = get_data("synthetic", n_train=64, n_test=16)
+    assert train.images is al.images
+    assert train.view.augment and not al.view.augment
+    assert len(train) == 64 and len(test) == 16
+    assert train.num_classes == 10
+
+
+def test_debug_mode_truncates():
+    train, test, al = get_data("synthetic", n_train=200, debug_mode=True)
+    assert len(train) == 50 and len(al) == 50
+
+
+def test_gather_batch_pads_and_masks():
+    train, _, _ = get_data("synthetic", n_train=10)
+    batch = gather_batch(train, np.array([1, 2, 3]), batch_size=8)
+    assert batch["image"].shape == (8, 32, 32, 3)
+    assert batch["mask"].tolist() == [1, 1, 1, 0, 0, 0, 0, 0]
+    assert batch["index"][:3].tolist() == [1, 2, 3]
+
+
+def test_iterate_batches_covers_all_once():
+    train, _, _ = get_data("synthetic", n_train=50)
+    seen = []
+    for b in iterate_batches(train, np.arange(50), 16):
+        seen.extend(b["index"][b["mask"] > 0].tolist())
+    assert sorted(seen) == list(range(50))
+    assert num_batches(50, 16) == 4
+
+
+def test_iterate_batches_prefetch_matches_sync():
+    train, _, _ = get_data("synthetic", n_train=40)
+    sync = list(iterate_batches(train, np.arange(40), 16))
+    pref = list(iterate_batches(train, np.arange(40), 16, num_threads=1))
+    assert len(sync) == len(pref)
+    for a, b in zip(sync, pref):
+        np.testing.assert_array_equal(a["image"], b["image"])
+
+
+def test_shuffle_requires_rng():
+    train, _, _ = get_data("synthetic", n_train=10)
+    with pytest.raises(ValueError):
+        batch_index_lists(np.arange(10), 4, shuffle=True)
+
+
+def test_apply_view_normalizes():
+    view = ViewSpec(CIFAR10_NORM, augment=False)
+    x = apply_view(jnp.full((2, 8, 8, 3), 128, dtype=jnp.uint8), view,
+                   train=False)
+    expected = (128.0 - 0.4914 * 255) / (0.2023 * 255)
+    assert abs(float(x[0, 0, 0, 0]) - expected) < 1e-4
+
+
+def test_random_crop_flip_shapes_and_determinism():
+    x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.uint8).reshape(2, 8, 8, 3)
+    key = jax.random.PRNGKey(0)
+    a = random_crop_flip(x, key, pad=2)
+    b = random_crop_flip(x, key, pad=2)
+    assert a.shape == x.shape
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = random_crop_flip(x, jax.random.PRNGKey(1), pad=2)
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_flip_only_when_pad_zero():
+    x = jnp.arange(1 * 4 * 4 * 3, dtype=jnp.uint8).reshape(1, 4, 4, 3)
+    out = random_crop_flip(x, jax.random.PRNGKey(0), pad=0)
+    # either identical or horizontally flipped
+    same = np.array_equal(np.asarray(out), np.asarray(x))
+    flipped = np.array_equal(np.asarray(out), np.asarray(x[:, :, ::-1, :]))
+    assert same or flipped
+
+
+def test_img_num_per_cls_exp_and_step():
+    counts = img_num_per_cls(1000, 10, "exp", 0.1)
+    assert counts[0] == 100 and counts[-1] == 10
+    assert all(a >= b for a, b in zip(counts, counts[1:]))
+    counts = img_num_per_cls(1000, 10, "step", 0.1)
+    assert counts[:5] == [100] * 5 and counts[5:] == [10] * 5
+    with pytest.raises(ValueError):
+        img_num_per_cls(1000, 10, "bogus", 0.1)
+
+
+def test_imbalanced_indices_seeded():
+    targets = np.repeat(np.arange(4), 25)
+    a = imbalanced_indices(targets, [25, 12, 6, 3], seed=0)
+    b = imbalanced_indices(targets, [25, 12, 6, 3], seed=0)
+    np.testing.assert_array_equal(a, b)
+    assert len(a) == 46
+    counts = np.bincount(targets[a], minlength=4)
+    np.testing.assert_array_equal(counts, [25, 12, 6, 3])
+
+
+def test_imbalanced_synthetic_dataset():
+    imb = ImbalanceConfig(imbalance_type="exp", imbalance_factor=0.1)
+    train, test, al = get_data("imbalanced_synthetic", imbalance_args=imb,
+                               n_train=1000)
+    counts = train.class_counts()
+    assert counts[0] > counts[-1]
+    assert len(train) == len(al)
+    assert train.images is al.images
+
+
+def test_image_folder_dataset(tmp_path):
+    from PIL import Image
+    from active_learning_tpu.data.imagenet import ImageFolderDataset
+    from active_learning_tpu.data.core import IMAGENET_NORM
+
+    for cls in ["a", "b"]:
+        os.makedirs(tmp_path / cls)
+        for i in range(3):
+            arr = np.full((40, 60, 3), 30 * i, dtype=np.uint8)
+            Image.fromarray(arr).save(tmp_path / cls / f"{i}.jpg")
+    view = ViewSpec(IMAGENET_NORM, augment=False)
+    ds = ImageFolderDataset(str(tmp_path), view, train_transform=False,
+                            num_classes=2, seed=0)
+    assert len(ds) == 6
+    np.testing.assert_array_equal(np.unique(ds.targets), [0, 1])
+    batch = ds.gather(np.array([0, 3]))
+    assert batch.shape == (2, 224, 224, 3)
+    # train view: random-resized crop also lands at 224
+    ds_tr = ImageFolderDataset(str(tmp_path), view, train_transform=True,
+                               num_classes=2, seed=0)
+    assert ds_tr.gather(np.array([1])).shape == (1, 224, 224, 3)
+
+
+def test_file_list_dataset(tmp_path):
+    from PIL import Image
+    from active_learning_tpu.data.imagenet import FileListDataset
+    from active_learning_tpu.data.core import IMAGENET_NORM
+
+    os.makedirs(tmp_path / "imgs")
+    lines = []
+    for i in range(4):
+        arr = np.full((50, 50, 3), 40 * i, dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / "imgs" / f"{i}.jpg")
+        lines.append(f"imgs/{i}.jpg {i % 2}")
+    list_file = tmp_path / "list.txt"
+    list_file.write_text("\n".join(lines))
+    view = ViewSpec(IMAGENET_NORM, augment=False)
+    ds = FileListDataset(str(tmp_path), str(list_file), view,
+                         train_transform=False, num_classes=2)
+    assert len(ds) == 4
+    assert ds.targets.tolist() == [0, 1, 0, 1]
+    assert ds.gather(np.array([2])).shape == (1, 224, 224, 3)

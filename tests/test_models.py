@@ -1,0 +1,104 @@
+"""Model tests: shapes, forward modes, freeze_feature stop-gradient,
+parameter-count parity with torchvision topology."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from active_learning_tpu.models.factory import get_network
+from active_learning_tpu.models.resnet import resnet18, resnet50
+
+
+def init_model(model, shape):
+    x = jnp.zeros(shape)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    return variables
+
+
+def test_resnet18_cifar_shapes():
+    model = resnet18(num_classes=10, cifar_stem=True)
+    variables = init_model(model, (2, 32, 32, 3))
+    x = jnp.ones((2, 32, 32, 3))
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    logits, emb = model.apply(variables, x, train=False, return_features=True)
+    assert emb.shape == (2, 512)
+    head_logits = model.apply(variables, emb, method="head")
+    np.testing.assert_allclose(np.asarray(head_logits), np.asarray(logits),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet50_embedding_dim():
+    model = resnet50(num_classes=10, cifar_stem=True)
+    variables = init_model(model, (1, 16, 16, 3))
+    _, emb = model.apply(variables, jnp.ones((1, 16, 16, 3)), train=False,
+                         return_features=True)
+    assert emb.shape == (1, 2048)
+    assert model.embed_dim == 2048
+
+
+def test_imagenet_stem_downsamples():
+    # Fully-convolutional + global pool: a small input exercises the same
+    # 7x7/s2 + maxpool stem path as 224x224 without the CPU compile cost.
+    model = resnet18(num_classes=1000, cifar_stem=False)
+    variables = init_model(model, (1, 64, 64, 3))
+    logits = model.apply(variables, jnp.ones((1, 64, 64, 3)), train=False)
+    assert logits.shape == (1, 1000)
+
+
+def test_param_count_matches_torchvision():
+    # torchvision resnet18 (1000 classes) has 11,689,512 params; ours splits
+    # fc into a separate head but the total must match.
+    model = resnet18(num_classes=1000, cifar_stem=False)
+    variables = init_model(model, (1, 64, 64, 3))
+    n = sum(np.prod(p.shape) for p in jax.tree.leaves(variables["params"]))
+    assert n == 11_689_512
+    # resnet50: 25,557,032.
+    model50 = resnet50(num_classes=1000, cifar_stem=False)
+    variables50 = init_model(model50, (1, 32, 32, 3))
+    n50 = sum(np.prod(p.shape) for p in jax.tree.leaves(variables50["params"]))
+    assert n50 == 25_557_032
+
+
+def test_freeze_feature_stops_gradient():
+    model = resnet18(num_classes=10, cifar_stem=True, freeze_feature=True)
+    variables = init_model(model, (2, 8, 8, 3))
+    x = jnp.ones((2, 8, 8, 3))
+
+    def loss_fn(params):
+        logits = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=False)
+        return logits.sum()
+
+    grads = jax.grad(loss_fn)(variables["params"])
+    # Head gets gradient; encoder gets exactly zero.
+    head_norm = np.abs(np.asarray(grads["linear"]["kernel"])).sum()
+    enc_norm = sum(
+        np.abs(np.asarray(g)).sum()
+        for g in jax.tree.leaves(grads["encoder"]))
+    assert head_norm > 0
+    assert enc_norm == 0
+
+
+def test_train_mode_updates_batch_stats():
+    model = resnet18(num_classes=10, cifar_stem=True)
+    variables = init_model(model, (4, 8, 8, 3))
+    x = jnp.linspace(0, 1, 4 * 8 * 8 * 3).reshape(4, 8, 8, 3)
+    _, updates = model.apply(variables, x, train=True,
+                             mutable=["batch_stats"])
+    before = variables["batch_stats"]["encoder"]["bn_stem"]["mean"]
+    after = updates["batch_stats"]["encoder"]["bn_stem"]["mean"]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+def test_factory_cifar_stem_rule():
+    m = get_network("cifar10", "SSLResNet18")
+    assert m.cifar_stem
+    m = get_network("imagenet", "SSLResNet18")
+    assert not m.cifar_stem
+    with pytest.raises(KeyError):
+        get_network("nope", "SSLResNet18")
+    with pytest.raises(KeyError):
+        get_network("cifar10", "NoSuchModel")
