@@ -1,0 +1,17 @@
+"""Acquisition strategies: the Strategy engine + the reference's 13 samplers.
+
+``get_strategy`` replaces the reference's eval()-based registry
+(src/query_strategies/get_strategy.py:16-17) with an explicit one.
+"""
+
+from ..registry import STRATEGIES
+from .base import Strategy, register_strategy
+
+# Importing a sampler module registers its classes.
+from . import random_sampler as _random_sampler  # noqa: F401
+from . import uncertainty as _uncertainty  # noqa: F401
+from . import mase as _mase  # noqa: F401
+
+
+def get_strategy(name: str):
+    return STRATEGIES.get(name)

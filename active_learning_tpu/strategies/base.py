@@ -1,0 +1,283 @@
+"""The active-learning Strategy engine.
+
+TPU-native counterpart of the reference's ``Strategy`` base class
+(src/query_strategies/strategy.py:21-485).  The reference interleaves pool
+bookkeeping, DDP process management, training, evaluation, and checkpointing
+in one 485-line class; here those concerns live in dedicated modules
+(pool.PoolState, train.Trainer, train.checkpoint, utils.metrics) and
+``Strategy`` composes them into the reference's public surface:
+
+    query(budget) -> (labeled_idxs, cost)   [abstract; per-sampler]
+    update(labeled_idxs, cost)              strategy.py:459-485
+    init_network_weights()                  strategy.py:175-200
+    train()                                 strategy.py:286-381
+    load_best_ckpt()                        strategy.py:202-206
+    test()                                  strategy.py:211-247
+
+Key architectural differences (deliberate, TPU-first):
+  * ONE persistent JAX runtime and mesh for the whole experiment — no
+    per-round mp.spawn/NCCL process groups (strategy.py:288-315).
+  * Pool scoring is mesh-parallel (strategies/scoring.py): the reference
+    scores on a single GPU in the parent process (SURVEY.md §2 parallelism
+    table).
+  * All randomness flows from one np.random.Generator + JAX PRNG, so a
+    round is exactly reproducible from saved state (the reference uses the
+    global np.random / torch seeds).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..config import ExperimentConfig, TrainConfig
+from ..data.core import Dataset
+from ..pool import PoolState
+from ..registry import STRATEGIES
+from ..train import checkpoint as ckpt_lib
+from ..train.trainer import Trainer, TrainState
+from ..utils.logging import get_logger
+from ..utils.metrics import MetricsSink, NullSink
+from . import scoring
+
+
+class Strategy:
+    """Base class: owns the model state, pool state, trainer, and metrics
+    sink for one experiment; subclasses implement ``query``.
+
+    Args mirror the reference constructor (strategy.py:74-124) in spirit:
+    the dataset triple, the model + trainer, pool state, and configs.
+    """
+
+    def __init__(
+        self,
+        train_set: Dataset,
+        al_set: Dataset,
+        test_set: Optional[Dataset],
+        model,
+        trainer: Trainer,
+        pool: PoolState,
+        cfg: ExperimentConfig,
+        train_cfg: TrainConfig,
+        sink: Optional[MetricsSink] = None,
+        rng: Optional[np.random.Generator] = None,
+    ):
+        self.train_set = train_set
+        self.al_set = al_set
+        self.test_set = test_set
+        self.model = model
+        self.trainer = trainer
+        self.pool = pool
+        self.cfg = cfg
+        self.train_cfg = train_cfg
+        self.sink = sink if sink is not None else NullSink()
+        self.rng = rng if rng is not None else np.random.default_rng(cfg.run_seed)
+        self.logger = get_logger()
+
+        self.num_classes = al_set.num_classes
+        self.mesh = trainer.mesh
+        self.state: Optional[TrainState] = None
+        self.best_epoch: int = 0
+        self._score_steps: Dict[str, Callable] = {}
+        # Per-experiment init key; split once per re-init so every round's
+        # random re-initialization is fresh but reproducible.
+        self._init_key = jax.random.PRNGKey(int(self.rng.integers(2 ** 31)))
+
+    # -- identity --------------------------------------------------------
+
+    @property
+    def round(self) -> int:
+        return self.pool.round
+
+    @round.setter
+    def round(self, value: int) -> None:
+        self.pool.round = int(value)
+
+    @property
+    def cumulative_cost(self) -> float:
+        return self.pool.cumulative_cost
+
+    @property
+    def exp_hash(self) -> str:
+        return self.cfg.exp_hash or "no_hash"
+
+    # -- pool views (strategy.py:126-163) --------------------------------
+
+    def available_query_idxs(self, shuffle: bool = True) -> np.ndarray:
+        return self.pool.available_query_idxs(shuffle=shuffle, rng=self.rng)
+
+    def available_query_mask(self) -> np.ndarray:
+        return self.pool.available_mask()
+
+    def already_labeled_idxs(self, shuffle: bool = False) -> np.ndarray:
+        return self.pool.labeled_idxs(shuffle=shuffle, rng=self.rng)
+
+    def already_labeled_mask(self) -> np.ndarray:
+        return self.pool.labeled_mask()
+
+    # -- weights (strategy.py:165-206) ------------------------------------
+
+    def weight_paths(self) -> Dict[str, str]:
+        return ckpt_lib.weight_paths(self.cfg.ckpt_path, self.cfg.exp_name,
+                                     self.exp_hash, self.round)
+
+    def init_network_weights(self) -> None:
+        """Fresh random init every round (so the linear head always resets,
+        strategy.py:182-184), then overlay a pretrained SSL/transfer ckpt if
+        one is configured (strategy.py:185-196)."""
+        self._init_key, sub = jax.random.split(self._init_key)
+        sample = self.train_set.gather(np.zeros(1, dtype=np.int64))
+        if self.state is None:
+            self.state = self.trainer.init_state(sub, sample)
+        else:
+            variables = self.model.init(sub, sample.astype(np.float32),
+                                        train=False)
+            self.state = self.trainer.replace_variables(self.state, variables)
+        if self.train_cfg.has_pretrained:
+            from ..utils.pretrained import apply_pretrained
+            variables = apply_pretrained(
+                dict(self.state.variables), self.train_cfg.pretrained)
+            self.state = self.trainer.replace_variables(self.state, variables)
+            self.logger.info(
+                f"Initialized network weights from "
+                f"{self.train_cfg.pretrained.path}")
+        else:
+            self.logger.info("Initialized Network Weights Randomly.")
+
+    def load_best_ckpt(self) -> None:
+        path = self.weight_paths()["best_ckpt"]
+        self.logger.info(f"Loading best ckpt so far from: {path}")
+        variables = ckpt_lib.load_variables(path, like=self.state.variables)
+        self.state = self.trainer.replace_variables(self.state, variables)
+
+    # -- the four verbs ---------------------------------------------------
+
+    def query(self, budget: int) -> Tuple[np.ndarray, int]:
+        raise NotImplementedError
+
+    def update(self, labeled_idxs, cur_cost: float) -> None:
+        """Mark queried examples labeled, spend budget, emit the audit
+        trail (strategy.py:459-485)."""
+        labeled_idxs = np.asarray(labeled_idxs, dtype=np.int64).reshape(-1)
+        self.pool.update(labeled_idxs, cur_cost)
+        self.sink.log_metric("cumulative_budget", self.pool.cumulative_cost,
+                             step=self.round)
+        self.logger.info(
+            f"Cumulative budget used on round {self.round} = "
+            f"{self.pool.cumulative_cost}")
+        self.sink.log_asset(f"labeled_idxs_on_rd_{self.round}",
+                            ",".join(str(int(e)) for e in labeled_idxs))
+
+    def train(self) -> None:
+        """Per-round training with validation + early stopping.  The mesh
+        is persistent — this replaces the whole mp.spawn/DDP stack
+        (strategy.py:286-381)."""
+        if self.state is None:
+            self.init_network_weights()
+        labeled = self.already_labeled_idxs()
+        self.logger.info(f"Starting training on round {self.round}")
+
+        def metric_cb(name: str, value: float, step: int) -> None:
+            self.sink.log_metric(name, value, step=step)
+
+        result = self.trainer.fit(
+            self.state,
+            self.train_set,
+            labeled,
+            self.al_set,
+            self.pool.eval_idxs,
+            n_epoch=self.cfg.n_epoch,
+            es_patience=self.cfg.early_stop_patience,
+            rng=self.rng,
+            round_idx=self.round,
+            weight_paths=self.weight_paths(),
+            metric_cb=metric_cb,
+        )
+        self.state = result.state
+        self.best_epoch = result.best_epoch
+        self.logger.info(f"Finished training on round {self.round}")
+
+    def test(self) -> Optional[float]:
+        """Test-set evaluation + the reference's metric schema: round- and
+        budget-keyed accuracy plus the per-class asset
+        (strategy.py:211-247)."""
+        if self.test_set is None:
+            self.logger.info("Skipped testing loop, no testing dataset found.")
+            return None
+        perf = self.trainer.evaluate(self.state, self.test_set,
+                                     np.arange(len(self.test_set)))
+        acc = float(perf["accuracy"])
+        top5 = float(perf["top_5_accuracy"])
+        byclass = np.asarray(perf["accuracy_byclass"])
+        order = np.argsort(byclass)
+        k = int(min(5, len(byclass)))
+        self.logger.info(
+            f"Test performance at round {self.round} is {acc * 100:.2f}%")
+        self.logger.info(
+            f"Best {k} classes: "
+            f"{ {int(i): f'{byclass[i] * 100:.2f}' for i in order[-k:]} }")
+        self.logger.info(
+            f"Worst {k} classes: "
+            f"{ {int(i): f'{byclass[i] * 100:.2f}' for i in order[:k]} }")
+        self.logger.info(
+            f"Test top 5 acc at round {self.round} is {top5 * 100:.2f}%")
+        self.sink.log_metrics(
+            {"rd_test_accuracy": acc, "rd_test_top5_accuracy": top5},
+            step=self.round)
+        self.sink.log_metrics(
+            {"budget_test_accuracy": acc, "budget_test_top5_accuracy": top5},
+            step=self.pool.cumulative_cost)
+        self.sink.log_asset(
+            f"test_acc_byclass_rd_{self.round}",
+            ",".join(f"{e:.2f}" for e in byclass))
+        return acc
+
+    # -- scoring infrastructure -------------------------------------------
+
+    def _score_batch_size(self) -> int:
+        return self.trainer.padded_batch_size(
+            self.train_cfg.loader_te.batch_size)
+
+    def _get_score_step(self, kind: str) -> Callable:
+        if kind not in self._score_steps:
+            view = self.al_set.view
+            if kind == "prob_stats":
+                self._score_steps[kind] = scoring.make_prob_stats_step(
+                    self.model, view)
+            elif kind == "embed":
+                self._score_steps[kind] = scoring.make_embed_step(
+                    self.model, view)
+            elif kind == "embed_margin":
+                self._score_steps[kind] = scoring.make_embed_step(
+                    self.model, view, with_probs=True)
+            elif kind == "mase":
+                self._score_steps[kind] = scoring.make_mase_step(
+                    self.model, view)
+            else:
+                raise KeyError(f"unknown scoring kind '{kind}'")
+        return self._score_steps[kind]
+
+    def collect_scores(self, idxs: np.ndarray, kind: str,
+                       keys=None) -> Dict[str, np.ndarray]:
+        """Mesh-parallel scoring pass over ``al_set[idxs]`` returning host
+        arrays aligned with ``idxs``."""
+        loader = self.train_cfg.loader_te
+        return scoring.collect_pool(
+            self.al_set, idxs, self._score_batch_size(),
+            self._get_score_step(kind), self.state.variables, self.mesh,
+            num_workers=loader.num_workers, prefetch=loader.prefetch,
+            keys=keys)
+
+
+def register_strategy(name: str):
+    """Decorator: register a Strategy subclass under its reference name
+    (replaces the eval()-based get_strategy, get_strategy.py:16-17)."""
+
+    def deco(cls):
+        STRATEGIES.register(name, cls)
+        cls.name = name
+        return cls
+
+    return deco

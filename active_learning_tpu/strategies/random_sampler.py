@@ -1,0 +1,58 @@
+"""Random and class-balanced-random acquisition.
+
+Reference: src/query_strategies/random_sampler.py:6-33 and
+balanced_random_sampler.py:7-101.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..initial_pool import balanced_allocation
+from .base import Strategy, register_strategy
+
+
+@register_strategy("RandomSampler")
+class RandomSampler(Strategy):
+    """Uniform random from the unlabeled pool: the pool is pre-shuffled by
+    ``available_query_idxs(shuffle=True)`` and the first ``budget`` taken
+    (random_sampler.py:21-31)."""
+
+    def query(self, budget: int) -> Tuple[np.ndarray, int]:
+        idxs = self.available_query_idxs(shuffle=True)
+        count = int(min(len(idxs), budget))
+        self.logger.info(f"Number of queried images: {count}")
+        return idxs[:count], count
+
+
+@register_strategy("BalancedRandomSampler")
+class BalancedRandomSampler(Strategy):
+    """CHEATING BASELINE: peeks at the true labels of unlabeled examples to
+    draw a class-balanced random batch (balanced_random_sampler.py:9-11).
+
+    The per-class quota is the water-filling allocation over per-class
+    availability (the threshold-search loop at
+    balanced_random_sampler.py:50-72, shared with the initial-pool
+    generator — see initial_pool.balanced_allocation)."""
+
+    def query(self, budget: int) -> Tuple[np.ndarray, int]:
+        targets = self.al_set.targets
+        avail_mask = self.available_query_mask()
+        budget = int(min(avail_mask.sum(), budget))
+
+        counts = np.bincount(targets[avail_mask], minlength=self.num_classes)
+        quota = balanced_allocation(counts, budget)
+
+        labeled_idxs = []
+        for c in np.flatnonzero(quota):
+            class_avail = np.flatnonzero((targets == c) & avail_mask)
+            picked = self.rng.permutation(class_avail)[: quota[c]]
+            labeled_idxs.append(picked)
+        labeled_idxs = np.concatenate(labeled_idxs) if labeled_idxs else \
+            np.zeros(0, dtype=np.int64)
+        assert np.unique(labeled_idxs).size == budget, (
+            "balanced query produced duplicates or wrong count")
+        self.logger.info(f"Number of queried images: {budget}")
+        return labeled_idxs, budget

@@ -1,0 +1,169 @@
+"""Sharded acquisition-scoring passes over the unlabeled pool.
+
+The reference scores the pool single-process on one GPU inside each
+sampler's ``query`` (e.g. src/query_strategies/margin_sampler.py:19-45,
+confidence_sampler.py:8-47, mase_sampler.py:30-96): a DataLoader walk with a
+per-batch forward, hauling full softmax/embedding tensors back to host.
+
+Here scoring is a first-class, mesh-parallel primitive: one jitted step per
+(model, view, statistic) computes the per-example statistics on device over
+a batch whose leading axis is sharded across the mesh's data axis, and only
+the tiny per-example results (a few floats each) return to host.  This is
+the "distributed acquisition scoring" row of SURVEY.md §2's parallelism
+table — the big TPU win the reference lacks.
+
+Every step function has signature ``step(variables, batch) -> dict`` where
+each dict value has leading batch axis, and every batch row carries its pool
+index and a validity mask (data/pipeline.py), so padding never contaminates
+scores.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.augment import apply_view
+from ..data.core import Dataset, ViewSpec
+from ..parallel import mesh as mesh_lib
+from ..data.pipeline import iterate_batches
+
+
+def make_prob_stats_step(model, view: ViewSpec) -> Callable:
+    """Per-example softmax statistics in one fused pass: top-1 probability
+    (ConfidenceSampler's score, confidence_sampler.py:33-36), top1-top2
+    probability margin (MarginSampler's score, margin_sampler.py:33-35) and
+    the predicted label."""
+
+    @jax.jit
+    def step(variables, batch):
+        x = apply_view(batch["image"], view, train=False)
+        logits = model.apply(variables, x, train=False)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top2, top2_idx = jax.lax.top_k(probs, 2)
+        return {
+            "confidence": top2[:, 0],
+            "margin": top2[:, 0] - top2[:, 1],
+            "pred": top2_idx[:, 0].astype(jnp.int32),
+        }
+
+    return step
+
+
+def make_embed_step(model, view: ViewSpec, with_probs: bool = False
+                    ) -> Callable:
+    """Final-embedding extraction (the reference's
+    ``return_features='finalembed'`` pass, coreset_sampler.py:43-58), with
+    optional softmax margin for MarginClusteringSampler
+    (margin_clustering_sampler.py:23-45)."""
+
+    @jax.jit
+    def step(variables, batch):
+        x = apply_view(batch["image"], view, train=False)
+        logits, embedding = model.apply(variables, x, train=False,
+                                        return_features=True)
+        out = {"embedding": embedding}
+        if with_probs:
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            top2, _ = jax.lax.top_k(probs, 2)
+            out["margin"] = top2[:, 0] - top2[:, 1]
+            out["pred"] = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return out
+
+    return step
+
+
+def boundary_radii(embedding: jnp.ndarray, kernel: jnp.ndarray,
+                   bias: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Closed-form distance from each embedding to every one-vs-one decision
+    boundary of the linear head (MASE, mase_sampler.py:59-79).
+
+    For predicted class c and any class j, the boundary is the hyperplane
+    {e : (w_c - w_j)·e + (b_c - b_j) = 0}; the L2 distance from e is
+    ((w_c - w_j)·e + b_c - b_j) / ||w_c - w_j||.  The j == c entry is 0/0
+    and mapped to +inf, matching the reference's nan -> inf fix-up.
+
+    kernel is the Flax Dense kernel [D, C]; bias [C].
+    """
+    logits = embedding @ kernel + bias  # [B, C]
+    preds = jnp.argmax(logits, axis=-1)  # [B]
+    w = kernel.T  # [C, D]
+    w_pred = w[preds]  # [B, D]
+    delta_w = w_pred[:, None, :] - w[None, :, :]  # [B, C, D]
+    delta_b = bias[preds][:, None] - bias[None, :]  # [B, C]
+    numer = jnp.einsum("bd,bcd->bc", embedding, delta_w) + delta_b
+    denom = jnp.linalg.norm(delta_w, axis=-1)  # [B, C]
+    radii = jnp.where(denom > 0, numer / jnp.maximum(denom, 1e-30), jnp.inf)
+    return {"radii": radii, "pred": preds.astype(jnp.int32)}
+
+
+def make_mase_step(model, view: ViewSpec) -> Callable:
+    """Per-class boundary radii + min margin, fully on device.
+
+    The reference materializes [B, C, D] tensors per batch on GPU
+    (mase_sampler.py:62-79); the einsum here contracts D immediately so the
+    peak live tensor is [B, C, D] only inside the fused XLA computation.
+    """
+
+    @jax.jit
+    def step(variables, batch):
+        x = apply_view(batch["image"], view, train=False)
+        _, embedding = model.apply(variables, x, train=False,
+                                   return_features=True)
+        kernel = variables["params"]["linear"]["kernel"]
+        bias = variables["params"]["linear"]["bias"]
+        out = boundary_radii(embedding, kernel, bias)
+        out["min_margin"] = jnp.min(out["radii"], axis=-1)
+        return out
+
+    return step
+
+
+def collect_pool(
+    dataset: Dataset,
+    idxs: np.ndarray,
+    batch_size: int,
+    step_fn: Callable,
+    variables,
+    mesh,
+    num_workers: int = 0,
+    prefetch: int = 2,
+    keys: Optional[Iterable[str]] = None,
+) -> Dict[str, np.ndarray]:
+    """Run ``step_fn`` over ``dataset[idxs]`` in fixed-shape sharded batches
+    and return host arrays of length ``len(idxs)``, row i scoring pool index
+    ``idxs[i]``.  Alignment is *enforced*: the per-batch index rows carried
+    by the pipeline (data/pipeline.py) are collected alongside the scores
+    and checked against ``idxs`` — the class of bug the reference has at
+    confidence_sampler.py:41 (sorting by a scrambled score vector) cannot
+    happen silently here.
+
+    This is the engine behind every sampler's scoring pass — the TPU
+    replacement for the reference's per-sampler DataLoader loops.
+
+    ``idxs`` must be non-empty (samplers guard the exhausted-pool case
+    before scoring).
+    """
+    idxs = np.asarray(idxs)
+    n = len(idxs)
+    if n == 0:
+        raise ValueError("collect_pool called with empty idxs; guard the "
+                         "exhausted-pool case in the sampler")
+    chunks: Dict[str, list] = {}
+    row_idxs: list = []
+    for batch in iterate_batches(dataset, idxs, batch_size,
+                                 num_threads=num_workers, prefetch=prefetch):
+        row_idxs.append(batch["index"].copy())
+        out = step_fn(variables, mesh_lib.shard_batch(batch, mesh))
+        if keys is not None:
+            out = {k: out[k] for k in keys}
+        for k, v in out.items():
+            chunks.setdefault(k, []).append(np.asarray(v))
+    got_idxs = np.concatenate(row_idxs, axis=0)[:n]
+    if not np.array_equal(got_idxs, idxs):
+        raise AssertionError(
+            "scoring rows misaligned with requested pool indices")
+    return {k: np.concatenate(v, axis=0)[:n] for k, v in chunks.items()}
