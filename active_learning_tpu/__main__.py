@@ -1,0 +1,6 @@
+"""``python -m active_learning_tpu`` — the reference's ``python main_al.py``
+(README.md:53)."""
+
+from .experiment.cli import main
+
+main()

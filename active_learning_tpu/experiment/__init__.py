@@ -1,0 +1,10 @@
+"""Experiment layer: arg-pool presets, driver (round loop), resume, CLI.
+
+Reference counterparts: src/arg_pools/*.py, src/main_al.py,
+src/utils/resume_training.py, src/utils/parser.py.
+"""
+
+from . import arg_pools  # noqa: F401  (registers the presets)
+from .driver import build_experiment, run_experiment  # noqa: F401
+from .resume import (has_saved_experiment, load_experiment,  # noqa: F401
+                     save_experiment)

@@ -1,0 +1,125 @@
+"""Command-line entry point.
+
+The reference's 30 argparse flags (src/utils/parser.py:7-92) mapped onto
+``ExperimentConfig``.  Run as:
+
+    python -m active_learning_tpu --dataset cifar10 --strategy MarginSampler \
+        --rounds 30 --round_budget 1000 --n_epoch 200 --early_stop_patience 50
+
+Flag names match the reference so published commands (README.md:53,
+src/gen_jobs.py) translate directly; comet-specific flags are replaced by
+the JSONL metrics sink (--disable_metrics).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+from ..config import ExperimentConfig, ImbalanceConfig, VAALConfig
+
+
+def get_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native active learning (parity with "
+                    "zeyademam/active_learning)")
+    # Experiment identity / logging (parser.py:9-25)
+    p.add_argument("--project_name", type=str, default="active-learning")
+    p.add_argument("--exp_name", type=str, default="active_learning")
+    p.add_argument("--exp_hash", type=str, default=None)
+    p.add_argument("--log_dir", type=str, default="./logs")
+    p.add_argument("--ckpt_path", type=str, default="./checkpoint")
+    p.add_argument("--disable_metrics", action="store_true",
+                   help="replaces --enable_comet (metrics on by default)")
+    # Dataset (parser.py:27-39)
+    p.add_argument("--dataset", type=str, default="cifar10",
+                   choices=["cifar10", "imbalanced_cifar10", "imagenet",
+                            "imbalanced_imagenet", "synthetic"])
+    p.add_argument("--dataset_dir", type=str, default=None)
+    p.add_argument("--arg_pool", type=str, default="default")
+    p.add_argument("--imbalance_type", type=str, default=None,
+                   choices=[None, "exp", "step"])
+    p.add_argument("--imbalance_factor", type=float, default=0.1)
+    p.add_argument("--imbalance_seed", type=int, default=0)
+    # AL globals (parser.py:41-58)
+    p.add_argument("--strategy", type=str, default="RandomSampler")
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--round_budget", type=int, default=5000)
+    p.add_argument("--freeze_feature", action="store_true")
+    p.add_argument("--init_pool_size", type=int, default=-1,
+                   help="-1 => round_budget; 0 => query at round 0")
+    p.add_argument("--init_pool_type", type=str, default="random",
+                   choices=["random", "random_balance"])
+    # Training (parser.py:60-69)
+    p.add_argument("--model", type=str, default="SSLResNet18",
+                   choices=["SSLResNet18", "SSLResNet50"])
+    p.add_argument("--resume_training", action="store_true")
+    p.add_argument("--n_epoch", type=int, default=60)
+    p.add_argument("--early_stop_patience", type=int, default=30,
+                   help="0 disables early stopping")
+    # Debug (parser.py:70-71)
+    p.add_argument("--debug_mode", action="store_true")
+    # Coreset / BADGE scale controls (parser.py:74-79)
+    p.add_argument("--subset_labeled", type=int, default=None)
+    p.add_argument("--subset_unlabeled", type=int, default=None)
+    p.add_argument("--partitions", type=int, default=1)
+    # VAAL (parser.py:81-92)
+    p.add_argument("--vae_latent_dim", type=int, default=64)
+    p.add_argument("--adversary_param", type=float, default=10.0)
+    p.add_argument("--lr_vae", type=float, default=5e-5)
+    p.add_argument("--lr_discriminator", type=float, default=1e-3)
+    # Seeds / mesh (TPU-specific)
+    p.add_argument("--run_seed", type=int, default=0)
+    p.add_argument("--num_devices", type=int, default=-1,
+                   help="-1 = all local devices")
+    return p
+
+
+def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
+    return ExperimentConfig(
+        project_name=args.project_name,
+        exp_name=args.exp_name,
+        exp_hash=args.exp_hash,
+        log_dir=args.log_dir,
+        ckpt_path=args.ckpt_path,
+        enable_metrics=not args.disable_metrics,
+        dataset=args.dataset,
+        dataset_dir=args.dataset_dir,
+        arg_pool=args.arg_pool,
+        imbalance=ImbalanceConfig(
+            imbalance_type=args.imbalance_type,
+            imbalance_factor=args.imbalance_factor,
+            imbalance_seed=args.imbalance_seed),
+        strategy=args.strategy,
+        rounds=args.rounds,
+        round_budget=args.round_budget,
+        freeze_feature=args.freeze_feature,
+        init_pool_size=args.init_pool_size,
+        init_pool_type=args.init_pool_type,
+        model=args.model,
+        resume_training=args.resume_training,
+        n_epoch=args.n_epoch,
+        early_stop_patience=args.early_stop_patience,
+        debug_mode=args.debug_mode,
+        subset_labeled=args.subset_labeled,
+        subset_unlabeled=args.subset_unlabeled,
+        partitions=args.partitions,
+        vaal=VAALConfig(
+            vae_latent_dim=args.vae_latent_dim,
+            adversary_param=args.adversary_param,
+            lr_vae=args.lr_vae,
+            lr_discriminator=args.lr_discriminator),
+        run_seed=args.run_seed,
+        num_devices=args.num_devices,
+    )
+
+
+def main(argv: Optional[List[str]] = None):
+    from .driver import run_experiment
+    args = get_parser().parse_args(argv)
+    cfg = args_to_config(args)
+    return run_experiment(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Round-level experiment save/restore.
+
+The reference pickles the ENTIRE strategy object + args + status every round
+(src/utils/resume_training.py:38-52) and unpickles it to resume
+(:8-35).  Pickling live objects is fragile (any code change breaks old
+checkpoints) so here the state is explicit arrays + json:
+
+  * pool state (labeled mask, eval idxs, recent, cost, round) — npz;
+  * the host RNG's bit-generator state and the per-experiment JAX init key —
+    resuming reproduces the SAME round-(n+1) query an uninterrupted run
+    would make;
+  * a config echo — compared on load with a warning on mismatch, like the
+    reference's args comparison (resume_training.py:22-25);
+  * the metrics experiment key, so the sink continues the same stream
+    (the reference reattaches the comet ExistingExperiment,
+    resume_training.py:29-32).
+
+Model weights are NOT duplicated here: the per-round best checkpoint
+(best_rd_{n}.msgpack, train/checkpoint.py) is the model state of record and
+is reloaded on resume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..config import ExperimentConfig, config_to_dict
+from ..pool import PoolState
+from ..utils.logging import get_logger
+
+STATE_FILE = "experiment_state.npz"
+META_FILE = "experiment_state.json"
+
+
+def _state_dir(cfg: ExperimentConfig) -> str:
+    exp_hash = cfg.exp_hash or "no_hash"
+    return os.path.join(cfg.ckpt_path, f"{cfg.exp_name}_{exp_hash}")
+
+
+def save_experiment(strategy, cfg: ExperimentConfig) -> str:
+    """Persist end-of-round state.  Called once per round after ``test()``
+    (reference: main_al.py:180 → save_experiment)."""
+    directory = _state_dir(cfg)
+    os.makedirs(directory, exist_ok=True)
+    arrays = strategy.pool.to_arrays()
+    arrays["init_key"] = np.asarray(strategy._init_key)
+    np.savez(os.path.join(directory, STATE_FILE), **arrays)
+    meta = {
+        "round": int(strategy.round),
+        "rng_state": strategy.rng.bit_generator.state,
+        "config": {k: _jsonable(v) for k, v in config_to_dict(cfg).items()},
+        "experiment_key": getattr(strategy.sink, "experiment_key", None),
+        "best_epoch": int(strategy.best_epoch),
+    }
+    with open(os.path.join(directory, META_FILE), "w") as fh:
+        json.dump(meta, fh, indent=2)
+    get_logger().info(f"Saved experiment state for round {strategy.round} "
+                      f"to {directory}")
+    return directory
+
+
+def has_saved_experiment(cfg: ExperimentConfig) -> bool:
+    d = _state_dir(cfg)
+    return (os.path.exists(os.path.join(d, STATE_FILE))
+            and os.path.exists(os.path.join(d, META_FILE)))
+
+
+def load_experiment(strategy, cfg: ExperimentConfig) -> int:
+    """Restore ``strategy`` in place from the last completed round; returns
+    the round to resume from (reference: load_experiment returns
+    ``previous_round + 1``, resume_training.py:35)."""
+    logger = get_logger()
+    directory = _state_dir(cfg)
+    with np.load(os.path.join(directory, STATE_FILE)) as arrs:
+        arrays = {k: arrs[k] for k in arrs.files}
+    with open(os.path.join(directory, META_FILE)) as fh:
+        meta = json.load(fh)
+
+    # Warn (don't fail) on config drift, mirroring resume_training.py:22-25.
+    current = {k: _jsonable(v) for k, v in config_to_dict(cfg).items()}
+    saved = meta.get("config", {})
+    for key in sorted(set(saved) | set(current)):
+        if key in ("resume_training",):
+            continue
+        if saved.get(key) != current.get(key):
+            logger.warning(
+                f"Resume config mismatch for '{key}': saved "
+                f"{saved.get(key)!r} != current {current.get(key)!r}")
+
+    init_key = arrays.pop("init_key")
+    strategy.pool = PoolState.from_arrays(arrays)
+    import jax
+    strategy._init_key = jax.numpy.asarray(init_key)
+    strategy.rng.bit_generator.state = meta["rng_state"]
+    strategy.best_epoch = int(meta.get("best_epoch", 0))
+
+    prev_round = int(meta["round"])
+    strategy.round = prev_round
+    # Reload the trained model of the completed round so the next round's
+    # query scores with it (the reference gets this for free by pickling the
+    # whole object with its weights).  The state skeleton is built with a
+    # throwaway key — NOT init_network_weights, which would consume a split
+    # of the restored _init_key (diverging post-resume training from an
+    # uninterrupted run) and pointlessly overlay any pretrained checkpoint
+    # right before load_best_ckpt overwrites it.
+    best = strategy.weight_paths()["best_ckpt"]
+    if os.path.exists(best):
+        if strategy.state is None:
+            import jax
+            sample = strategy.train_set.gather(np.zeros(1, dtype=np.int64))
+            strategy.state = strategy.trainer.init_state(
+                jax.random.PRNGKey(0), sample)
+        strategy.load_best_ckpt()
+    logger.info(f"Resuming experiment from round {prev_round + 1}")
+    return prev_round + 1
+
+
+def saved_experiment_key(cfg: ExperimentConfig) -> Optional[str]:
+    """The metrics experiment key of a saved run (for sink reattachment)."""
+    path = os.path.join(_state_dir(cfg), META_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return json.load(fh).get("experiment_key")
+
+
+def _jsonable(v: Any):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(e) for e in v]
+    return str(v)

@@ -68,7 +68,16 @@ def accumulate_metrics(count_iter: Iterator[Dict[str, jnp.ndarray]]
             totals = counts
         else:
             totals = {k: totals[k] + counts[k] for k in totals}
-    assert totals is not None, "no eval batches"
+    if totals is None:
+        # Empty eval set (eval_split=0): report zero accuracy instead of
+        # crashing mid-fit; callers treat 0 as "no signal".
+        return {
+            "accuracy": np.float32(0.0), "top_5_accuracy": np.float32(0.0),
+            "accuracy_byclass": np.zeros(0, np.float32),
+            "corrects_byclass": np.zeros(0, np.float32),
+            "count_byclass": np.zeros(0, np.float32),
+            "count": np.float32(0.0),
+        }
     count = max(totals["count"], 1.0)
     with np.errstate(divide="ignore", invalid="ignore"):
         byclass = totals["corrects_byclass"] / totals["count_byclass"]

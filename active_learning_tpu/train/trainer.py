@@ -77,11 +77,13 @@ class Trainer:
     """Owns the jitted train/eval steps for one (model, train-config) pair."""
 
     def __init__(self, model, train_cfg: TrainConfig, mesh,
-                 num_classes: int, train_bn: Optional[bool] = None):
+                 num_classes: int, train_bn: Optional[bool] = None,
+                 current_ckpt_every: int = 25):
         self.model = model
         self.cfg = train_cfg
         self.mesh = mesh
         self.num_classes = num_classes
+        self.current_ckpt_every = max(1, int(current_ckpt_every))
         self.logger = get_logger()
         self.tx = make_optimizer(train_cfg.optimizer)
         self.lr_at = make_lr_schedule(train_cfg.scheduler,
@@ -263,7 +265,10 @@ class Trainer:
                 self.logger.info(
                     f"\tValidation performance on round {round_idx} at "
                     f"epoch {epoch} is {eval_acc * 100:.2f}%")
-                if metric_cb and epoch % 25 == 0:
+                # Per-epoch validation curves, like the reference's comet
+                # logging (strategy.py:419-422) — the paper's curves need
+                # every epoch, not a subsample.
+                if metric_cb:
                     metric_cb(f"rd_{round_idx}_validation_accuracy",
                               eval_acc, epoch)
                     metric_cb(f"rd_{round_idx}_validation_top5_accuracy",
@@ -278,7 +283,11 @@ class Trainer:
                                                 best_variables)
                 else:
                     es_count += 1
-                if weight_paths:
+                # The reference writes the latest ckpt every epoch
+                # (strategy.py:440) and never consumes it; a full-variable
+                # host transfer per epoch would dominate small-model epochs
+                # on TPU, so write it periodically + on exit instead.
+                if weight_paths and epoch % self.current_ckpt_every == 0:
                     ckpt_lib.save_variables(weight_paths["current_ckpt"],
                                             jax.tree.map(np.asarray,
                                                          state.variables))
@@ -293,6 +302,10 @@ class Trainer:
             if weight_paths:
                 ckpt_lib.save_variables(weight_paths["best_ckpt"],
                                         best_variables)
+        if weight_paths:
+            ckpt_lib.save_variables(weight_paths["current_ckpt"],
+                                    jax.tree.map(np.asarray,
+                                                 state.variables))
         self.logger.info(
             f"Sanity Check: Best ckpt occurs on epoch {best_epoch}")
         return FitResult(state=state, best_epoch=best_epoch,

@@ -1,0 +1,224 @@
+"""Pretrained (SSL / transfer) checkpoint ingestion: torch state_dict ->
+Flax variables.
+
+Reference: src/utils/load_pretrained_weights.py:5-66 — state-dict surgery
+(``module.`` prefix stripping, substring ``skip_key``/``required_key``
+filtering, ``replace_key`` renaming) followed by a PARTIAL update of the
+network's dict (``init_dict.update(net_dict)``), so layers absent from the
+checkpoint (the fresh linear head) keep their random init.  The MoCo-v2
+mapping (``encoder_q`` -> ``encoder``, skip ``fc``) comes from
+src/arg_pools/ssp_finetuning.py:34-37.
+
+The TPU-side extra work is the layout conversion from torchvision ResNet
+naming/shapes to this repo's Flax model (models/resnet.py):
+
+  torch key                         flax path
+  ------------------------------------------------------------------
+  encoder.conv1.weight              params/encoder/conv_stem/kernel (OIHW->HWIO)
+  encoder.bn1.{weight,bias}         params/encoder/bn_stem/{scale,bias}
+  encoder.bn1.running_{mean,var}    batch_stats/encoder/bn_stem/{mean,var}
+  encoder.layerL.B.convN.weight     params/encoder/stageL_blockB/Conv_{N-1}/kernel
+  encoder.layerL.B.bnN.*            params/encoder/stageL_blockB/BatchNorm_{N-1}/*
+  encoder.layerL.B.downsample.0/1   .../downsample_conv / downsample_bn
+  linear.weight                     params/linear/kernel ([C,D] -> [D,C])
+
+``num_batches_tracked`` has no Flax counterpart and is dropped.  Unmappable
+leftover keys are an error — silently ignoring them is how a wrong
+checkpoint goes unnoticed.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, Mapping, Optional, Tuple
+
+import numpy as np
+
+from ..config import PretrainedConfig
+from .logging import get_logger
+
+FlaxPath = Tuple[str, ...]  # (collection, module..., leaf)
+
+
+def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read a torch checkpoint into {key: np.ndarray} (CPU, no grads).
+    Handles the common ``{"state_dict": ...}`` wrapper (MoCo et al.),
+    matching load_pretrained_weights.py:24-26."""
+    import torch
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    if isinstance(ckpt, dict) and "state_dict" in ckpt:
+        ckpt = ckpt["state_dict"]
+    return {k: np.asarray(v.detach().numpy() if hasattr(v, "detach") else v)
+            for k, v in ckpt.items()}
+
+
+def surgery(
+    state: Mapping[str, np.ndarray],
+    required_key: Optional[Iterable[str]] = None,
+    skip_key: Optional[Iterable[str]] = None,
+    replace_map: Optional[Mapping[str, str]] = None,
+) -> Dict[str, np.ndarray]:
+    """The reference's key filtering/renaming, verbatim semantics
+    (load_pretrained_weights.py:27-61): drop keys containing any
+    ``skip_key`` substring; drop keys containing NO ``required_key``
+    substring; strip a ``module.`` DataParallel prefix; then apply the
+    first matching ``replace_map`` substring rename."""
+    replace_map = dict(replace_map or {})
+    required = tuple(required_key or ())
+    skip = tuple(skip_key or ())
+
+    def keep(k: str) -> bool:
+        if any(s in k for s in skip):
+            return False
+        if required and not any(s in k for s in required):
+            return False
+        return True
+
+    def rename(k: str) -> str:
+        for old, new in replace_map.items():
+            if old in k:
+                return k.replace(old, new)
+        return k
+
+    out: Dict[str, np.ndarray] = {}
+    for k, v in state.items():
+        if not keep(k):
+            continue
+        if k.startswith("module."):
+            k = k[len("module."):]
+        out[rename(k)] = v
+    return out
+
+
+_BN_LEAF = {"weight": ("params", "scale"), "bias": ("params", "bias"),
+            "running_mean": ("batch_stats", "mean"),
+            "running_var": ("batch_stats", "var")}
+
+
+def torch_key_to_flax(key: str) -> Optional[Tuple[FlaxPath, Optional[str]]]:
+    """Map one torchvision-ResNet-style key to (flax path, transform).
+
+    transform: None | "conv" (OIHW->HWIO) | "dense" (transpose).
+    Returns None for keys with no Flax counterpart
+    (``num_batches_tracked``).  Raises KeyError for unrecognized keys.
+    """
+    if key.endswith("num_batches_tracked"):
+        return None
+    parts = key.split(".")
+    if parts[0] == "encoder":
+        rest = parts[1:]
+        # Stem: conv1 / bn1 at the top level of the torchvision encoder.
+        if rest[0] == "conv1" and rest[1] == "weight":
+            return (("params", "encoder", "conv_stem", "kernel"), "conv")
+        if rest[0] == "bn1":
+            coll, leaf = _BN_LEAF[rest[1]]
+            return ((coll, "encoder", "bn_stem", leaf), None)
+        m = re.fullmatch(r"layer(\d+)", rest[0])
+        if m:
+            stage = int(m.group(1))
+            block = int(rest[1])
+            mod = f"stage{stage}_block{block}"
+            sub = rest[2]
+            leaf = rest[3]
+            cm = re.fullmatch(r"conv(\d+)", sub)
+            if cm and leaf == "weight":
+                return (("params", "encoder", mod,
+                         f"Conv_{int(cm.group(1)) - 1}", "kernel"), "conv")
+            bm = re.fullmatch(r"bn(\d+)", sub)
+            if bm:
+                coll, l = _BN_LEAF[leaf]
+                return ((coll, "encoder", mod,
+                         f"BatchNorm_{int(bm.group(1)) - 1}", l), None)
+            if sub == "downsample":
+                which = rest[3]
+                leaf = rest[4]
+                if which == "0" and leaf == "weight":
+                    return (("params", "encoder", mod, "downsample_conv",
+                             "kernel"), "conv")
+                if which == "1":
+                    coll, l = _BN_LEAF[leaf]
+                    return ((coll, "encoder", mod, "downsample_bn", l), None)
+        if rest[0] == "fc":
+            # The encoder's original fc: replaced by Identity in the
+            # reference (resnet_simclr.py:21); nothing to load into.
+            return None
+    if parts[0] == "linear":
+        if parts[1] == "weight":
+            return (("params", "linear", "kernel"), "dense")
+        if parts[1] == "bias":
+            return (("params", "linear", "bias"), None)
+    raise KeyError(f"No Flax mapping for torch key '{key}'")
+
+
+def _transform(value: np.ndarray, kind: Optional[str]) -> np.ndarray:
+    if kind == "conv":
+        return np.transpose(value, (2, 3, 1, 0))  # OIHW -> HWIO
+    if kind == "dense":
+        return np.transpose(value, (1, 0))  # [C, D] -> [D, C]
+    return value
+
+
+def overlay_torch_state(variables: Dict[str, Any],
+                        torch_state: Mapping[str, np.ndarray],
+                        strict: bool = True) -> Dict[str, Any]:
+    """Partial update: write every mappable checkpoint tensor into a copy of
+    ``variables`` (the reference's ``init_dict.update(net_dict)``,
+    load_pretrained_weights.py:64-65).  Shape mismatches always raise;
+    unknown keys raise when ``strict``."""
+    import jax
+    flat = _flatten(variables)
+    loaded = 0
+    for key, value in torch_state.items():
+        try:
+            mapped = torch_key_to_flax(key)
+        except KeyError:
+            if strict:
+                raise
+            continue
+        if mapped is None:
+            continue
+        path, kind = mapped
+        arr = _transform(np.asarray(value), kind)
+        if path not in flat:
+            raise KeyError(
+                f"Checkpoint key '{key}' maps to {'/'.join(path)}, absent "
+                f"from the model (wrong depth/variant?)")
+        if tuple(flat[path].shape) != tuple(arr.shape):
+            raise ValueError(
+                f"Shape mismatch for '{key}' -> {'/'.join(path)}: "
+                f"ckpt {arr.shape} vs model {tuple(flat[path].shape)}")
+        flat[path] = arr.astype(np.asarray(flat[path]).dtype)
+        loaded += 1
+    get_logger().info(f"Overlaid {loaded} pretrained tensors")
+    return _unflatten(flat)
+
+
+def apply_pretrained(variables: Dict[str, Any],
+                     cfg: PretrainedConfig) -> Dict[str, Any]:
+    """Full pipeline: load -> surgery -> overlay.  Called from
+    Strategy.init_network_weights after the random re-init
+    (strategy.py:185-196)."""
+    state = load_torch_state_dict(cfg.path)
+    state = surgery(state, required_key=cfg.required_key,
+                    skip_key=cfg.skip_key, replace_map=cfg.replace_map)
+    return overlay_torch_state(variables, state)
+
+
+def _flatten(tree: Any, prefix: FlaxPath = ()) -> Dict[FlaxPath, Any]:
+    out: Dict[FlaxPath, Any] = {}
+    if isinstance(tree, Mapping):
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (str(k),)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def _unflatten(flat: Dict[FlaxPath, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, value in flat.items():
+        node = out
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = value
+    return out
