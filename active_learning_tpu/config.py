@@ -139,6 +139,10 @@ class ExperimentConfig:
     dataset: str = "cifar10"
     dataset_dir: Optional[str] = None
     arg_pool: str = "default"
+    # Root onto which an arg pool's relative pretrained-ckpt path is rebased
+    # (the reference hardcodes a ../pretrained_ckpt layout,
+    # ssp_finetuning.py:13).
+    pretrained_root: Optional[str] = None
     imbalance: ImbalanceConfig = dataclasses.field(default_factory=ImbalanceConfig)
 
     # Active-learning globals

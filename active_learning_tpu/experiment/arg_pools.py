@@ -64,6 +64,15 @@ DEFAULT_POOL: Dict[str, TrainConfig] = {
         optimizer=OptimizerConfig("sgd", lr=0.1, weight_decay=1e-4,
                                   momentum=0.9),
         scheduler=SchedulerConfig("step", step_size=60, gamma=0.1)),
+    # Extension beyond the reference (whose default pool has no
+    # imbalanced_imagenet entry, so the dataset can't run at all there):
+    # the ImageNet recipe + class-weighted loss.
+    "imbalanced_imagenet": TrainConfig(
+        eval_split=0.01, loader_tr=_IMAGENET_TR, loader_te=_IMAGENET_TE,
+        optimizer=OptimizerConfig("sgd", lr=0.1, weight_decay=1e-4,
+                                  momentum=0.9),
+        scheduler=SchedulerConfig("step", step_size=60, gamma=0.1),
+        imbalanced_training=True),
 }
 
 SSP_FINETUNING_POOL: Dict[str, TrainConfig] = {

@@ -37,6 +37,8 @@ def get_parser() -> argparse.ArgumentParser:
                             "imbalanced_imagenet", "synthetic"])
     p.add_argument("--dataset_dir", type=str, default=None)
     p.add_argument("--arg_pool", type=str, default="default")
+    p.add_argument("--pretrained_root", type=str, default=None,
+                   help="rebase an arg pool's relative pretrained-ckpt path")
     p.add_argument("--imbalance_type", type=str, default=None,
                    choices=[None, "exp", "step"])
     p.add_argument("--imbalance_factor", type=float, default=0.1)
@@ -86,6 +88,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         dataset=args.dataset,
         dataset_dir=args.dataset_dir,
         arg_pool=args.arg_pool,
+        pretrained_root=args.pretrained_root,
         imbalance=ImbalanceConfig(
             imbalance_type=args.imbalance_type,
             imbalance_factor=args.imbalance_factor,

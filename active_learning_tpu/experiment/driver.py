@@ -60,7 +60,8 @@ def build_experiment(
     rewrite the round-0 audit asset.
     """
     if train_cfg is None:
-        train_cfg = arg_pools_lib.get_train_config(cfg.arg_pool, cfg.dataset)
+        train_cfg = arg_pools_lib.get_train_config(
+            cfg.arg_pool, cfg.dataset, pretrained_root=cfg.pretrained_root)
     if data is None:
         imbalance_args = {
             "imbalance_type": cfg.imbalance.imbalance_type,
@@ -82,22 +83,25 @@ def build_experiment(
     trainer = Trainer(model, train_cfg, mesh, num_classes)
 
     targets = train_set.targets[: len(train_set)]
-    eval_idxs = generate_eval_idxs(targets, num_classes,
-                                   ratio=train_cfg.eval_split,
-                                   random_seed=cfg.eval_split_seed)
     init_pool_size = cfg.resolved_init_pool_size()
-    if init_pool_size == 0:
-        init_idxs = np.zeros(0, dtype=np.int64)
-    else:
-        init_idxs = generate_init_lb_idxs(
-            targets, num_classes, eval_idxs, init_pool_size,
-            init_pool_type=cfg.init_pool_type,
-            random_seed=cfg.init_pool_seed)
     if cfg.debug_mode:
         # Tiny fixed pools for smoke runs (main_al.py:87-92).
         init_idxs = (np.zeros(0, dtype=np.int64) if init_pool_size == 0
                      else np.arange(5, dtype=np.int64))
         eval_idxs = np.arange(15, 20, dtype=np.int64)
+    else:
+        eval_idxs = generate_eval_idxs(targets, num_classes,
+                                       ratio=train_cfg.eval_split,
+                                       random_seed=cfg.eval_split_seed)
+        if init_pool_size == 0 or skip_init_pool:
+            # On resume the restored pool replaces the init pool — skip the
+            # (ImageNet-scale) balanced-index generation entirely.
+            init_idxs = np.zeros(0, dtype=np.int64)
+        else:
+            init_idxs = generate_init_lb_idxs(
+                targets, num_classes, eval_idxs, init_pool_size,
+                init_pool_type=cfg.init_pool_type,
+                random_seed=cfg.init_pool_seed)
 
     pool = PoolState.create(len(al_set), eval_idxs)
     rng = np.random.default_rng(cfg.run_seed)
@@ -126,6 +130,13 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     logger = setup_logging(cfg.log_dir, log_filename)
 
     resuming = cfg.resume_training and resume_lib.has_saved_experiment(cfg)
+    if cfg.resume_training and not resuming:
+        # Never silently restart a run the user asked to resume (the
+        # reference would die unpickling a missing file, resume_training.py:13).
+        raise FileNotFoundError(
+            f"--resume_training: no saved experiment state for "
+            f"exp_name={cfg.exp_name!r} exp_hash={cfg.exp_hash!r} under "
+            f"{cfg.ckpt_path!r}; pass the original --exp_hash/--ckpt_path")
     if sink is None:
         key = (resume_lib.saved_experiment_key(cfg) if resuming
                else cfg.exp_hash)

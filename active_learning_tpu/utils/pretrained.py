@@ -165,8 +165,8 @@ def overlay_torch_state(variables: Dict[str, Any],
     ``variables`` (the reference's ``init_dict.update(net_dict)``,
     load_pretrained_weights.py:64-65).  Shape mismatches always raise;
     unknown keys raise when ``strict``."""
-    import jax
-    flat = _flatten(variables)
+    from flax.traverse_util import flatten_dict, unflatten_dict
+    flat = flatten_dict(variables)
     loaded = 0
     for key, value in torch_state.items():
         try:
@@ -190,7 +190,7 @@ def overlay_torch_state(variables: Dict[str, Any],
         flat[path] = arr.astype(np.asarray(flat[path]).dtype)
         loaded += 1
     get_logger().info(f"Overlaid {loaded} pretrained tensors")
-    return _unflatten(flat)
+    return unflatten_dict(flat)
 
 
 def apply_pretrained(variables: Dict[str, Any],
@@ -204,21 +204,3 @@ def apply_pretrained(variables: Dict[str, Any],
     return overlay_torch_state(variables, state)
 
 
-def _flatten(tree: Any, prefix: FlaxPath = ()) -> Dict[FlaxPath, Any]:
-    out: Dict[FlaxPath, Any] = {}
-    if isinstance(tree, Mapping):
-        for k, v in tree.items():
-            out.update(_flatten(v, prefix + (str(k),)))
-    else:
-        out[prefix] = tree
-    return out
-
-
-def _unflatten(flat: Dict[FlaxPath, Any]) -> Dict[str, Any]:
-    out: Dict[str, Any] = {}
-    for path, value in flat.items():
-        node = out
-        for p in path[:-1]:
-            node = node.setdefault(p, {})
-        node[path[-1]] = value
-    return out

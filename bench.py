@@ -1,0 +1,157 @@
+"""Headline benchmark: jitted train-step throughput on the flagship model.
+
+Measures images/sec/chip for the CIFAR-10 protocol model (SSLResNet18,
+SimCLR CIFAR stem, 32x32 inputs, on-device augmentation fused into the
+step) in bfloat16 over the full local mesh, plus mesh-parallel pool-scoring
+throughput — the two hot paths of an AL round (BASELINE.md metric list).
+
+Prints exactly ONE JSON line to stdout:
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+Diagnostics (per-chip breakdown, MFU estimate, scoring throughput) go to
+stderr.
+
+vs_baseline: the reference publishes no throughput numbers (BASELINE.md —
+"not published in repo"), so the comparison point is the well-documented
+envelope of its hardware: ~1,800 images/sec for ResNet-18/CIFAR-10 training
+(fp32, batch 128, torch) on the 1x V100-SXM2 node the reference targets
+(README.md:44-47).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+V100_RESNET18_CIFAR_IPS = 1800.0  # estimated reference envelope, see above
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_train_step(trainer, mesh, batch_size: int, view,
+                     warmup: int = 10, iters: int = 200):
+    import jax
+    import jax.numpy as jnp
+    from active_learning_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "image": rng.integers(0, 256, size=(batch_size, 32, 32, 3),
+                              dtype=np.uint8),
+        "label": rng.integers(0, 10, size=batch_size).astype(np.int32),
+        "index": np.arange(batch_size, dtype=np.int32),
+        "mask": np.ones(batch_size, dtype=np.float32),
+    }
+    batch = mesh_lib.shard_batch(host_batch, mesh)
+    state = trainer.init_state(jax.random.PRNGKey(0),
+                               host_batch["image"][:8])
+    class_weights = jnp.ones(trainer.num_classes, jnp.float32)
+    lr = jnp.float32(0.1)
+    key = jax.random.PRNGKey(1)
+
+    for _ in range(warmup):
+        key, sub = jax.random.split(key)
+        state, loss = trainer._train_step(state, batch, sub, lr,
+                                          class_weights, view=view)
+    float(loss)  # host fetch — proves the device really finished
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        key, sub = jax.random.split(key)
+        state, loss = trainer._train_step(state, batch, sub, lr,
+                                          class_weights, view=view)
+    # block_until_ready can return early on remote-execution backends; a
+    # host fetch of a value data-dependent on every step (the step chain
+    # threads the state) cannot.
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    try:
+        lowered = trainer._train_step.lower(state, batch, key, lr,
+                                            class_weights, view=view)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        if flops:
+            log(f"train step: {flops / 1e9:.1f} GFLOP/step, "
+                f"{flops * iters / dt / 1e12:.1f} TFLOP/s achieved")
+    except Exception as e:
+        log(f"cost analysis unavailable: {e!r}")
+    return batch_size * iters / dt, state
+
+
+def bench_scoring(model, state, mesh, batch_size: int, view,
+                  warmup: int = 3, iters: int = 20):
+    """Mesh-parallel acquisition-scoring throughput (prob-stats pass)."""
+    import jax
+    from active_learning_tpu.parallel import mesh as mesh_lib
+    from active_learning_tpu.strategies import scoring
+
+    rng = np.random.default_rng(1)
+    host_batch = {
+        "image": rng.integers(0, 256, size=(batch_size, 32, 32, 3),
+                              dtype=np.uint8),
+        "mask": np.ones(batch_size, dtype=np.float32),
+    }
+    batch = mesh_lib.shard_batch(host_batch, mesh)
+    step = scoring.make_prob_stats_step(model, view)
+    variables = state.variables
+    out = None
+    for _ in range(warmup):
+        out = step(variables, batch)
+    float(out["margin"][0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(variables, batch)
+    float(out["margin"][0])  # host fetch, see bench_train_step
+    return batch_size * iters / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from active_learning_tpu.config import LoaderConfig, TrainConfig
+    from active_learning_tpu.data.core import CIFAR10_NORM, ViewSpec
+    from active_learning_tpu.models.resnet import resnet18
+    from active_learning_tpu.parallel import mesh as mesh_lib
+    from active_learning_tpu.train.trainer import Trainer
+
+    mesh = mesh_lib.make_mesh(-1)
+    n_chips = mesh.devices.size
+    per_chip = 256
+    batch_size = per_chip * n_chips
+    log(f"devices: {jax.devices()}  (batch {batch_size} = "
+        f"{per_chip}/chip x {n_chips})")
+
+    model = resnet18(num_classes=10, cifar_stem=True, dtype=jnp.bfloat16)
+    cfg = TrainConfig(loader_tr=LoaderConfig(batch_size=batch_size))
+    trainer = Trainer(model, cfg, mesh, num_classes=10, train_bn=True)
+    train_view = ViewSpec(CIFAR10_NORM, augment=True, pad=4)
+    score_view = ViewSpec(CIFAR10_NORM, augment=False)
+
+    ips, state = bench_train_step(trainer, mesh, batch_size, train_view)
+    ips_chip = ips / n_chips
+    log(f"train step: {ips:,.0f} img/s total, {ips_chip:,.0f} img/s/chip")
+
+    try:
+        score_ips = bench_scoring(model, state, mesh, batch_size, score_view)
+        log(f"pool scoring: {score_ips:,.0f} img/s total, "
+            f"{score_ips / n_chips:,.0f} img/s/chip")
+    except Exception as e:  # diagnostics only — never break the headline
+        log(f"scoring bench failed: {e!r}")
+
+    print(json.dumps({
+        "metric": "resnet18_cifar_train_images_per_sec_per_chip",
+        "value": round(ips_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_chip / V100_RESNET18_CIFAR_IPS, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# A sitecustomize hook may have imported jax and pinned a hardware platform
+# before this file ran (making the env vars above too late); the config
+# update wins as long as no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
 # Persistent compilation cache: repeated test runs skip XLA recompiles.
 _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
