@@ -161,6 +161,8 @@ class ExperimentConfig:
 
     # Debug
     debug_mode: bool = False
+    # Capture an XLA profiler trace (TensorBoard/XProf) for the run.
+    profile_dir: Optional[str] = None
 
     # Coreset / BADGE partitioning (parser.py:74-79)
     subset_labeled: Optional[int] = None

@@ -80,7 +80,8 @@ class _DiskImageDataset(Dataset):
         self.image_size = image_size
         self.resize_size = resize_size
         self._limit = limit
-        self._rng = np.random.default_rng(seed)
+        self._seed = seed
+        self._epoch = 0
         self.image_shape = (image_size, image_size, 3)
 
     def __len__(self) -> int:
@@ -88,14 +89,23 @@ class _DiskImageDataset(Dataset):
             return min(self._limit, len(self.paths))
         return len(self.paths)
 
-    def _decode_one(self, path: str) -> np.ndarray:
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the crop-RNG stream: crops are a pure function of
+        (seed, epoch, index) — reproducible regardless of gather order or
+        decode-thread interleaving (torch draws crop params from a shared
+        global stream, so its crops depend on worker scheduling)."""
+        self._epoch = int(epoch)
+
+    def _decode_one(self, path: str, index: int) -> np.ndarray:
         PILImage = _require_pil()
         with open(path, "rb") as fh:
             img = PILImage.open(fh).convert("RGB")
         s = self.image_size
         if self.train_transform:
+            rng = np.random.default_rng(
+                (self._seed, self._epoch, int(index)))
             top, left, ch, cw = random_resized_crop_params(
-                img.height, img.width, self._rng)
+                img.height, img.width, rng)
             img = img.resize((s, s), PILImage.BILINEAR,
                              box=(left, top, left + cw, top + ch))
         else:
@@ -114,7 +124,7 @@ class _DiskImageDataset(Dataset):
     def gather(self, idxs: np.ndarray) -> np.ndarray:
         out = np.empty((len(idxs), *self.image_shape), dtype=np.uint8)
         for i, idx in enumerate(np.asarray(idxs)):
-            out[i] = self._decode_one(self.paths[int(idx)])
+            out[i] = self._decode_one(self.paths[int(idx)], int(idx))
         return out
 
 

@@ -14,8 +14,7 @@ acquisition scores map back to pool indices.
 
 from __future__ import annotations
 
-import queue
-import threading
+import itertools
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -69,9 +68,12 @@ def iterate_batches(
     prefetch: int = 2,
     num_threads: int = 0,
 ) -> Iterator[Dict[str, np.ndarray]]:
-    """Yield fixed-shape host batches, optionally prefetched on a
-    background thread (num_threads > 0).  Thread prefetch matters for
-    disk-backed datasets where ``gather`` decodes images."""
+    """Yield fixed-shape host batches; with ``num_threads > 0``, N worker
+    threads gather/decode batches concurrently and results are reassembled
+    IN ORDER (the reference's num_workers DataLoader processes,
+    arg_pools/default.py:29-38).  Concurrency matters for disk-backed
+    datasets where ``gather`` decodes JPEGs; in-flight work is bounded by
+    ``num_threads + prefetch`` batches."""
     batches = batch_index_lists(idxs, batch_size, shuffle=shuffle, rng=rng,
                                 drop_last=drop_last)
     if num_threads <= 0:
@@ -79,32 +81,27 @@ def iterate_batches(
             yield gather_batch(dataset, b, batch_size)
         return
 
-    q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
-    stop = threading.Event()
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
 
-    def worker():
-        try:
-            for b in batches:
-                if stop.is_set():
-                    return
-                q.put(gather_batch(dataset, b, batch_size))
-        except BaseException as e:  # surface errors on the consumer side
-            q.put(e)
-        finally:
-            q.put(None)
-
-    t = threading.Thread(target=worker, daemon=True)
-    t.start()
+    executor = ThreadPoolExecutor(max_workers=num_threads,
+                                  thread_name_prefix="al-gather")
     try:
-        while True:
-            item = q.get()
-            if item is None:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        pending: deque = deque()
+        it = iter(batches)
+        max_inflight = num_threads + max(1, prefetch)
+        for b in itertools.islice(it, max_inflight):
+            pending.append(executor.submit(gather_batch, dataset, b,
+                                           batch_size))
+        while pending:
+            batch = pending.popleft().result()  # ordered; errors propagate
+            nxt = next(it, None)
+            if nxt is not None:
+                pending.append(executor.submit(gather_batch, dataset, nxt,
+                                               batch_size))
+            yield batch
     finally:
-        stop.set()
+        executor.shutdown(wait=False, cancel_futures=True)
 
 
 def num_batches(n: int, batch_size: int, drop_last: bool = False) -> int:

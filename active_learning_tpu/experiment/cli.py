@@ -61,6 +61,8 @@ def get_parser() -> argparse.ArgumentParser:
                    help="0 disables early stopping")
     # Debug (parser.py:70-71)
     p.add_argument("--debug_mode", action="store_true")
+    p.add_argument("--profile_dir", type=str, default=None,
+                   help="capture an XLA profiler trace to this directory")
     # Coreset / BADGE scale controls (parser.py:74-79)
     p.add_argument("--subset_labeled", type=int, default=None)
     p.add_argument("--subset_unlabeled", type=int, default=None)
@@ -104,6 +106,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         n_epoch=args.n_epoch,
         early_stop_patience=args.early_stop_patience,
         debug_mode=args.debug_mode,
+        profile_dir=args.profile_dir,
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,
         partitions=args.partitions,

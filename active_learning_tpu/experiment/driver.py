@@ -20,7 +20,6 @@ metric names (main_al.py:24-40).
 
 from __future__ import annotations
 
-import time
 import uuid
 from datetime import date
 from typing import Optional, Tuple
@@ -36,6 +35,7 @@ from ..pool import PoolState
 from ..strategies import get_strategy
 from ..utils.logging import get_logger, setup_logging
 from ..utils.metrics import MetricsSink, make_sink
+from ..utils.tracing import phase_timer, profiler_session
 from ..train.trainer import Trainer
 from . import arg_pools as arg_pools_lib
 from . import resume as resume_lib
@@ -159,48 +159,35 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     logger.info(f"Log file name: {log_filename}")
     logger.info(f"Mesh: {strategy.mesh.devices.size} devices")
 
-    for rd in range(start_round, cfg.rounds):
-        strategy.round = rd
-        logger.info(f"Active Learning Round {rd} start.")
+    with profiler_session(cfg.profile_dir):
+        for rd in range(start_round, cfg.rounds):
+            strategy.round = rd
+            logger.info(f"Active Learning Round {rd} start.")
 
-        # Round 0 only queries when there is no initial pool — with an SSL
-        # or transfer-learned init the model can score the pool before any
-        # labels exist (main_al.py:149-157).
-        al_round_0 = rd == 0 and init_pool_size == 0
-        if rd > 0 or al_round_0:
-            if al_round_0:
+            # Round 0 only queries when there is no initial pool — with an
+            # SSL or transfer-learned init the model can score the pool
+            # before any labels exist (main_al.py:149-157).
+            al_round_0 = rd == 0 and init_pool_size == 0
+            if rd > 0 or al_round_0:
+                if al_round_0:
+                    strategy.init_network_weights()
+                with phase_timer("query_time", rd, sink, logger):
+                    labeled_idxs, cur_cost = strategy.query(
+                        cfg.round_budget)
+                strategy.update(labeled_idxs, cur_cost)
+
+            with phase_timer("init_network_weights_time", rd, sink, logger):
                 strategy.init_network_weights()
-            t0 = time.time()
-            labeled_idxs, cur_cost = strategy.query(cfg.round_budget)
-            _phase(sink, logger, rd, "query_time", time.time() - t0)
-            strategy.update(labeled_idxs, cur_cost)
+            with phase_timer("train_time", rd, sink, logger):
+                strategy.train()
+            with phase_timer("load_best_ckpt_time", rd, sink, logger):
+                strategy.load_best_ckpt()
+            with phase_timer("test_time", rd, sink, logger):
+                strategy.test()
 
-        t0 = time.time()
-        strategy.init_network_weights()
-        _phase(sink, logger, rd, "init_network_weights_time",
-               time.time() - t0)
-
-        t0 = time.time()
-        strategy.train()
-        _phase(sink, logger, rd, "train_time", time.time() - t0)
-
-        t0 = time.time()
-        strategy.load_best_ckpt()
-        _phase(sink, logger, rd, "load_best_ckpt_time", time.time() - t0)
-
-        t0 = time.time()
-        strategy.test()
-        _phase(sink, logger, rd, "test_time", time.time() - t0)
-
-        resume_lib.save_experiment(strategy, cfg)
-        cfg.resume_training = True  # a crash after this resumes (main_al.py:181)
-        if len(strategy.available_query_idxs(shuffle=False)) == 0:
-            logger.info("Finished querying all Images!")
-            break
+            resume_lib.save_experiment(strategy, cfg)
+            cfg.resume_training = True  # crash after this resumes (main_al.py:181)
+            if len(strategy.available_query_idxs(shuffle=False)) == 0:
+                logger.info("Finished querying all Images!")
+                break
     return strategy
-
-
-def _phase(sink: MetricsSink, logger, rd: int, name: str,
-           seconds: float) -> None:
-    logger.info(f"Rd {rd} {name} is {seconds:.3f}s")
-    sink.log_metric(f"rd_{name}", seconds, step=rd)

@@ -1,0 +1,111 @@
+"""Experiment-sweep job generator.
+
+Prints the shell commands for the paper's experiment grids
+(reference: src/gen_jobs.py:3-145) against this package's CLI
+(``python -m active_learning_tpu``).  Three sweeps:
+
+  * ImageNet linear evaluation — SSLResNet50, frozen features, 8 rounds x
+    10k budget, 30k init pool, 50k/80k subsets, 10 partitions
+    (gen_jobs.py:3-42);
+  * ImageNet end-to-end SSP finetuning — same protocol, 60 epochs,
+    patience 30 (gen_jobs.py:45-86);
+  * CIFAR-10 (balanced or imbalanced) — SSLResNet18, 30 rounds x 1k,
+    200 epochs, patience 50 (gen_jobs.py:89-138).
+
+Run: ``python -m active_learning_tpu.experiment.gen_jobs [dataset_dir]``.
+"""
+
+from __future__ import annotations
+
+import sys
+from itertools import product
+from typing import List, Sequence
+
+IMAGENET_STRATEGIES = (
+    "RandomSampler", "BalancedRandomSampler", "MASESampler",
+    "MarginSampler", "ConfidenceSampler", "BASESampler", "VAALSampler",
+    "PartitionedCoresetSampler", "PartitionedBADGESampler")
+
+CIFAR_STRATEGIES = (
+    "RandomSampler", "BalancedRandomSampler", "MASESampler",
+    "MarginSampler", "ConfidenceSampler", "BASESampler",
+    "BalancingSampler", "VAALSampler", "CoresetSampler", "BADGESampler")
+
+CLI = "python -m active_learning_tpu"
+
+
+def _init_pool_flag(strategy: str) -> str:
+    pool_type = ("random_balance" if strategy == "BalancedRandomSampler"
+                 else "random")
+    return f"--init_pool_type {pool_type}"
+
+
+def imagenet_experiments(dataset_dir: str, arg_pool: str,
+                         extra: str = "") -> List[str]:
+    jobs = []
+    for strategy in IMAGENET_STRATEGIES:
+        jobs.append(
+            f"{CLI} --dataset_dir {dataset_dir} "
+            f"--exp_name {strategy}_arg_{arg_pool}_imagenet_b10000 "
+            f"--dataset imagenet --arg_pool {arg_pool} "
+            f"--model SSLResNet50 --strategy {strategy} "
+            f"--rounds 8 --round_budget 10000 --init_pool_size 30000 "
+            f"--subset_labeled 50000 --subset_unlabeled 80000 "
+            f"--partitions 10 {extra}{_init_pool_flag(strategy)}")
+    return jobs
+
+
+def linear_evaluation_imagenet_experiments(dataset_dir: str) -> List[str]:
+    return imagenet_experiments(dataset_dir, "ssp_linear_evaluation",
+                                extra="--freeze_feature ")
+
+
+def end_to_end_imagenet_experiments_pretrained(dataset_dir: str
+                                               ) -> List[str]:
+    return imagenet_experiments(
+        dataset_dir, "ssp_finetuning",
+        extra="--early_stop_patience 30 --n_epoch 60 ")
+
+
+def cifar10_experiments(dataset_dir: str, number_of_runs: int = 1,
+                        n_epoch: int = 200, rounds: int = 30,
+                        imbalanced: bool = False,
+                        round_budgets: Sequence[int] = (1000,)) -> List[str]:
+    if imbalanced:
+        dataset = "imbalanced_cifar10"
+        arg_pool = "ssp_finetuning_imbalanced_cifar10_imb_0_1"
+        imb = "--imbalance_factor 0.1 --imbalance_type exp "
+    else:
+        dataset = "cifar10"
+        arg_pool = "ssp_finetuning"
+        imb = ""
+    jobs = []
+    for _, strategy, budget in product(range(number_of_runs),
+                                       CIFAR_STRATEGIES, round_budgets):
+        jobs.append(
+            f"{CLI} --dataset_dir {dataset_dir} "
+            f"--exp_name {strategy}_arg_{arg_pool}_{dataset}_b{budget} "
+            f"--dataset {dataset} --arg_pool {arg_pool} "
+            f"--n_epoch {n_epoch} --early_stop_patience 50 "
+            f"--model SSLResNet18 --strategy {strategy} "
+            f"--rounds {rounds} --round_budget {budget} "
+            f"--init_pool_size {budget} {imb}{_init_pool_flag(strategy)}")
+    return jobs
+
+
+def all_jobs(dataset_dir: str = "<YOUR DATASET DIR HERE>") -> List[str]:
+    return (linear_evaluation_imagenet_experiments(dataset_dir)
+            + end_to_end_imagenet_experiments_pretrained(dataset_dir)
+            + cifar10_experiments(dataset_dir)
+            + cifar10_experiments(dataset_dir, imbalanced=True))
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    dataset_dir = argv[0] if argv else "<YOUR DATASET DIR HERE>"
+    for job in all_jobs(dataset_dir):
+        print(job)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""VAAL's auxiliary models: the WAE-style VAE and the latent discriminator.
+
+Reference: src/query_strategies/vae.py:18-102 (4-conv encoder / 3-deconv
+decoder + 1x1 output conv, fc_mu/fc_logvar heads, reparameterization) and
+vaal_discriminator.py:5-31 (z -> 512 -> 512 -> 1 MLP + sigmoid).
+
+Shape bookkeeping: the reference's ``latent_scale`` (1 for CIFAR, 2 for
+ImageNet, vaal_sampler.py:23-29) only encodes the post-encoder spatial size
+for a 32 / 64 pixel input; here the flatten is dynamic and the decoder's
+start resolution is ``crop // 8``, so any crop divisible by 16 works and
+the two reference cases reproduce exactly (32 -> 1024*2*2 flat, decoder
+4x4 start; 64 -> 1024*4*4 flat, 8x8 start).
+
+Init parity: the reference applies kaiming-normal to nn.Conv2d/nn.Linear
+only — its ConvTranspose2d layers keep torch defaults because the
+isinstance check misses them (vae.py:105-108); deconvs here likewise keep
+the Flax default init.  NHWC layout, float32 (these nets are tiny next to
+the classifier).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+kaiming_init = nn.initializers.variance_scaling(2.0, "fan_in", "normal")
+
+_ENC_FEATURES = (128, 256, 512, 1024)
+_DEC_FEATURES = (512, 256, 128)
+CROP_HW = 64  # vae.py:6-7; inputs smaller than this are used whole
+
+
+class VAE(nn.Module):
+    """Conv VAE over ``crop x crop`` inputs (vae.py:18-102)."""
+
+    z_dim: int = 32
+    nc: int = 3
+    crop: int = 32
+
+    def setup(self):
+        assert self.crop % 16 == 0 or self.crop in (32,), (
+            "crop must be divisible by 16")
+        self.enc_convs = [
+            nn.Conv(f, (4, 4), (2, 2), padding=[(1, 1), (1, 1)],
+                    use_bias=False, kernel_init=kaiming_init,
+                    name=f"enc_conv{i}")
+            for i, f in enumerate(_ENC_FEATURES)]
+        self.enc_bns = [
+            nn.BatchNorm(momentum=0.9, epsilon=1e-5, name=f"enc_bn{i}")
+            for i in range(len(_ENC_FEATURES))]
+        self.fc_mu = nn.Dense(self.z_dim, kernel_init=kaiming_init,
+                              name="fc_mu")
+        self.fc_logvar = nn.Dense(self.z_dim, kernel_init=kaiming_init,
+                                  name="fc_logvar")
+
+        start = self.crop // 8
+        self.dec_dense = nn.Dense(1024 * start * start,
+                                  kernel_init=kaiming_init, name="dec_dense")
+        # torch ConvTranspose2d(k=4, s=2, p=1) doubles the spatial size; in
+        # flax's conv_transpose the padding applies to the dilated input, so
+        # the equivalent explicit padding is k-1-p = 2 per side.
+        self.dec_deconvs = [
+            nn.ConvTranspose(f, (4, 4), (2, 2), padding=((2, 2), (2, 2)),
+                             use_bias=False, name=f"dec_deconv{i}")
+            for i, f in enumerate(_DEC_FEATURES)]
+        self.dec_bns = [
+            nn.BatchNorm(momentum=0.9, epsilon=1e-5, name=f"dec_bn{i}")
+            for i in range(len(_DEC_FEATURES))]
+        self.dec_out = nn.Conv(self.nc, (1, 1), kernel_init=kaiming_init,
+                               name="dec_out")
+
+    def encode(self, x, train: bool = True):
+        for conv, bn in zip(self.enc_convs, self.enc_bns):
+            x = nn.relu(bn(conv(x), use_running_average=not train))
+        x = x.reshape((x.shape[0], -1))
+        return self.fc_mu(x), self.fc_logvar(x)
+
+    def decode(self, z, train: bool = True):
+        start = self.crop // 8
+        x = self.dec_dense(z).reshape((-1, start, start, 1024))
+        for deconv, bn in zip(self.dec_deconvs, self.dec_bns):
+            x = nn.relu(bn(deconv(x), use_running_average=not train))
+        return self.dec_out(x)
+
+    def __call__(self, x, eps_key=None, train: bool = True):
+        """-> (recon, z, mu, logvar).  ``eps_key`` drives the
+        reparameterization draw (vae.py:90-96); None means z = mu (used by
+        the scoring pass, which only consumes mu anyway)."""
+        mu, logvar = self.encode(x, train=train)
+        if eps_key is None:
+            z = mu
+        else:
+            std = jnp.exp(0.5 * logvar)
+            z = mu + std * jax.random.normal(eps_key, mu.shape, mu.dtype)
+        recon = self.decode(z, train=train)
+        return recon, z, mu, logvar
+
+
+class Discriminator(nn.Module):
+    """Latent-space adversary (vaal_discriminator.py:5-21)."""
+
+    z_dim: int = 32
+
+    @nn.compact
+    def __call__(self, z):
+        z = nn.relu(nn.Dense(512, kernel_init=kaiming_init)(z))
+        z = nn.relu(nn.Dense(512, kernel_init=kaiming_init)(z))
+        z = nn.Dense(1, kernel_init=kaiming_init)(z)
+        return nn.sigmoid(z)
+
+
+def crop_size_for(image_hw: int) -> int:
+    """The reference crops >=64px inputs to 64 and uses smaller inputs
+    whole (vae.py:65-78)."""
+    return CROP_HW if image_hw >= CROP_HW else image_hw
+
+
+def random_crop(x: jnp.ndarray, crop: int, key: jax.Array) -> jnp.ndarray:
+    """One shared crop window for the whole batch AND for every VAE call in
+    the same training step — the reference seeds np.random with a per-batch
+    crop seed, so its labeled/unlabeled/discriminator forwards all see the
+    same window (vaal_sampler.py:214, vae.py:62-78)."""
+    b, h, w, c = x.shape
+    if h <= crop and w <= crop:
+        return x
+    oh = jax.random.randint(key, (), 0, h - crop + 1)
+    ow = jax.random.randint(jax.random.fold_in(key, 1), (), 0, w - crop + 1)
+    return jax.lax.dynamic_slice(x, (0, oh, ow, 0), (b, crop, crop, c))

@@ -11,6 +11,10 @@ from .base import Strategy, register_strategy
 from . import random_sampler as _random_sampler  # noqa: F401
 from . import uncertainty as _uncertainty  # noqa: F401
 from . import mase as _mase  # noqa: F401
+from . import coreset as _coreset  # noqa: F401
+from . import clustering as _clustering  # noqa: F401
+from . import balancing as _balancing  # noqa: F401
+from . import vaal as _vaal  # noqa: F401
 
 
 def get_strategy(name: str):

@@ -1,0 +1,100 @@
+"""Class-balancing acquisition for imbalanced pools (WACV 2020).
+
+Reference: src/query_strategies/balancing_sampler.py:8-136.  Per selection:
+if the labeled class distribution is imbalanced relative to the remaining
+budget, pick the unlabeled point whose distance to the rarest-class
+centroid, normalized by its largest distance to any majority-class
+centroid, is smallest; otherwise pick uniformly at random.
+
+The embedding pass over the WHOLE al_set (:39-53) is mesh-parallel here and
+cached under ``freeze_feature`` (:34-36, 55-57).  The per-pick loop is host
+NumPy: each step is O(N * M) on a few-thousand-row slice and data-dependent
+on the previous pick, so there is nothing for the mesh to win.
+
+Reference quirks preserved deliberately:
+  * the normalizer is the MAX distance to the majority centroids despite
+    the variable's name (:116-118);
+  * centroids use the TRUE labels of just-picked examples immediately
+    (label peeking mid-round, like the cheating BalancedRandomSampler);
+  * a rarest-class count of zero sets the numerator to 1 (:106-109).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .base import Strategy, register_strategy
+
+
+@register_strategy("BalancingSampler")
+class BalancingSampler(Strategy):
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._saved_embeddings: Optional[np.ndarray] = None
+
+    def _all_embeddings(self) -> np.ndarray:
+        if self.cfg.freeze_feature and self._saved_embeddings is not None:
+            return self._saved_embeddings
+        all_idxs = np.arange(len(self.al_set), dtype=np.int64)
+        emb = self.collect_scores(all_idxs, "embed",
+                                  keys=("embedding",))["embedding"]
+        if self.cfg.freeze_feature:
+            self._saved_embeddings = emb
+        return emb
+
+    def query(self, budget: int) -> Tuple[np.ndarray, int]:
+        ys = self.al_set.targets[: len(self.al_set)]
+        idxs_for_query = self.available_query_mask().copy()
+        idxs_labeled = self.already_labeled_mask().copy()
+        budget = int(min(idxs_for_query.sum(), budget))
+        if budget == 0:
+            return np.zeros(0, dtype=np.int64), 0
+        embeddings = self._all_embeddings()  # float32, like the reference
+        n_classes = self.num_classes
+
+        selected = []
+        for query_count in range(budget):
+            ys_labeled = ys[idxs_labeled]
+            counts = np.bincount(ys_labeled, minlength=n_classes)
+            mean_count = counts.mean()
+            maj = counts > mean_count
+            minor = ~maj
+            avg_maj = counts[maj].sum() / max(maj.sum(), 1)
+            avg_minor = counts[minor].sum() / max(minor.sum(), 1)
+
+            remaining = budget - query_count
+            if remaining <= minor.sum() * (avg_maj - avg_minor):
+                # Balancing pick (:83-125).
+                emb_labeled = embeddings[idxs_labeled]
+                centers = np.zeros((n_classes, embeddings.shape[1]))
+                np.add.at(centers, ys_labeled, emb_labeled)
+                denom = counts[:, None] + 1e-5
+                centers = centers / denom
+                rarest = int(np.argmin(counts))
+                emb_unlabeled = embeddings[idxs_for_query]
+
+                d_rare = ((emb_unlabeled - centers[rarest]) ** 2).sum(1)
+                if counts[rarest] == 0:
+                    d_rare = np.ones_like(d_rare)
+                centers_maj = centers[maj]
+                a2 = (emb_unlabeled ** 2).sum(1, keepdims=True)
+                b2 = (centers_maj ** 2).sum(1, keepdims=True)
+                d_maj = a2 + b2.T - 2.0 * emb_unlabeled @ centers_maj.T
+                norm = d_maj.max(axis=1)  # the reference's max (:116)
+                score = d_rare / norm
+                local = int(np.argmin(score))
+                query_idx = int(np.flatnonzero(idxs_for_query)[local])
+            else:
+                # Balanced enough: random pick (:126-128).
+                query_idx = int(self.rng.choice(
+                    np.flatnonzero(idxs_for_query)))
+
+            idxs_for_query[query_idx] = False
+            idxs_labeled[query_idx] = True
+            selected.append(query_idx)
+
+        self.logger.info(f"Number of queried images: {budget}")
+        return np.asarray(selected, dtype=np.int64), budget

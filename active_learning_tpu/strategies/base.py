@@ -255,6 +255,12 @@ class Strategy:
             elif kind == "mase":
                 self._score_steps[kind] = scoring.make_mase_step(
                     self.model, view)
+            elif kind == "badge":
+                self._score_steps[kind] = scoring.make_badge_step(
+                    self.model, view)
+            elif kind == "badge_pool":
+                self._score_steps[kind] = scoring.make_badge_step(
+                    self.model, view, pool_512=True)
             else:
                 raise KeyError(f"unknown scoring kind '{kind}'")
         return self._score_steps[kind]

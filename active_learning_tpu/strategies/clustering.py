@@ -1,0 +1,93 @@
+"""Margin-clustering acquisition: HAC clusters + round-robin min-margin.
+
+Reference: src/query_strategies/margin_clustering_sampler.py:9-90
+(arXiv:2107.14263).  One mesh-parallel pass produces embeddings AND softmax
+margins (the reference walks a DataLoader computing both per batch,
+:23-44); agglomerative clustering stays on host (sklearn — it is inherently
+sequential and runs once), and the round-robin selection is cheap index
+math.
+
+Cluster-cache semantics preserved exactly (:56-61, :89): cluster once on
+the first query and carry assignments forward with queried examples
+removed — valid because ``available_query_idxs(shuffle=False)`` is sorted
+and shrinks by exactly the queried examples each round.  With a
+``subset_unlabeled`` cap the subset is re-drawn and re-clustered every
+round.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .base import Strategy, register_strategy
+
+N_CLUSTERS = 20  # margin_clustering_sampler.py:59
+
+
+@register_strategy("MarginClusteringSampler")
+class MarginClusteringSampler(Strategy):
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.cluster_assignment: Optional[np.ndarray] = None
+
+    def get_embeddings_and_margins(self, idxs: np.ndarray):
+        out = self.collect_scores(idxs, "embed_margin",
+                                  keys=("embedding", "margin"))
+        return out["embedding"], out["margin"]
+
+    def query(self, budget: int) -> Tuple[np.ndarray, int]:
+        subset = self.cfg.subset_unlabeled
+        if subset is None:
+            idxs_for_hac = self.available_query_idxs(shuffle=False)
+        else:
+            idxs_for_hac = np.sort(
+                self.available_query_idxs(shuffle=True)[:subset])
+        if len(idxs_for_hac) == 0:
+            return idxs_for_hac, 0
+
+        need_clustering = self.cluster_assignment is None or subset is not None
+        if need_clustering:
+            embeddings, margins = self.get_embeddings_and_margins(
+                idxs_for_hac)
+            from sklearn.cluster import AgglomerativeClustering
+            n_clusters = min(N_CLUSTERS, len(idxs_for_hac))
+            assignment = AgglomerativeClustering(
+                n_clusters=n_clusters).fit(embeddings).labels_.copy()
+        else:
+            # Cached-assignment rounds only need fresh margins — skip the
+            # [N, D] embedding haul entirely.
+            margins = self.collect_scores(idxs_for_hac, "prob_stats",
+                                          keys=("margin",))["margin"]
+            assignment = self.cluster_assignment
+
+        cluster_ids, cluster_count = np.unique(assignment,
+                                               return_counts=True)
+        # Smallest clusters first; ties by id (:64-66).
+        order = sorted(zip(cluster_count.tolist(), cluster_ids.tolist()))
+        cluster_ids_sorted = [cid for _, cid in order]
+
+        budget = int(min(len(idxs_for_hac), budget))
+        query_idxs = []
+        start_cluster = 0
+        while len(query_idxs) < budget:
+            # Round-robin: one min-margin pick per remaining cluster, small
+            # clusters first; a cluster that empties advances the start
+            # pointer (:71-87).
+            for i in range(start_cluster, len(cluster_ids_sorted)):
+                cid = cluster_ids_sorted[i]
+                members = np.flatnonzero(assignment == cid)
+                pick = members[np.argmin(margins[members])]
+                assignment[pick] = -1
+                query_idxs.append(int(idxs_for_hac[pick]))
+                if len(members) == 1:
+                    start_cluster += 1
+                if len(query_idxs) >= budget:
+                    break
+
+        # Carry forward assignments of the still-unqueried examples (:89).
+        self.cluster_assignment = assignment[assignment != -1]
+        self.logger.info(f"Number of queried images: {budget}")
+        return np.asarray(query_idxs, dtype=np.int64), budget

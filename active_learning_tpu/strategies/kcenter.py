@@ -1,0 +1,208 @@
+"""Device-resident greedy k-center (and k-means++-style randomized variant).
+
+This is the sequential core of Coreset/BADGE acquisition.  The reference
+materializes the full N x N squared-L2 matrix on GPU and, per selection
+step, recomputes the min over all labeled columns
+(src/query_strategies/coreset_sampler.py:59-105) — O(N^2) memory and
+O(budget * N * L) work, with a host round-trip per step.
+
+The TPU design keeps only the factor matrices and a length-N min-distance
+vector on device and runs the whole selection as ONE ``lax.scan`` of
+``budget`` steps — no N x N matrix, no per-step host sync:
+
+  * Embeddings are a tuple of FACTOR matrices.  Plain coreset is one factor
+    X [N, D] with dot(i,j) = X_i . X_j.  BADGE's gradient embedding
+    g_i = (softmax(z_i) - onehot(argmax z_i)) (x) e_i (badge_sampler.py:40)
+    is rank-1, so it is stored as TWO factors (A [N, C], E [N, D]) with
+    dot(i,j) = (A_i . A_j)(E_i . E_j) — the C*D-dim outer product is never
+    materialized.  Adaptive average pooling of a rank-1 matrix is itself
+    rank-1 (the mean over a bin rectangle of a_c * e_d is the product of
+    the two bin means), so the pooled variant (badge_sampler.py:41-44)
+    keeps the same factorized form.
+  * Each scan step does one fused [N, K] matvec per factor plus an
+    argmax/categorical draw, then the incremental min-distance update
+    min_dist <- min(min_dist, d(., new)) — equivalent to the reference's
+    full recomputation because min over a growing set is associative.
+
+Distances are SQUARED L2 throughout, matching the reference (it never
+takes a sqrt; the randomized mode's selection probabilities are therefore
+k-means++ D^2 weights, coreset_sampler.py:80-92).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Factors = Tuple[jnp.ndarray, ...]
+
+
+def self_sq_norms(factors: Factors) -> jnp.ndarray:
+    """||g_i||^2 = prod_F (F_i . F_i)  — [N]."""
+    out = None
+    for f in factors:
+        s = jnp.sum(f * f, axis=1)
+        out = s if out is None else out * s
+    return out
+
+
+def dots_to(factors: Factors, idx) -> jnp.ndarray:
+    """g_. . g_idx = prod_F (F @ F_idx)  — [N]."""
+    out = None
+    for f in factors:
+        d = f @ f[idx]
+        out = d if out is None else out * d
+    return out
+
+
+def dots_to_many(factors: Factors, idxs) -> jnp.ndarray:
+    """g_. . g_j for j in idxs — [N, K] (blocked initial-min helper)."""
+    out = None
+    for f in factors:
+        d = f @ f[idxs].T
+        out = d if out is None else out * d
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(3,))
+def _min_dist_chunk(factors: Factors, sqn: jnp.ndarray, chunk: jnp.ndarray,
+                    min_dist: jnp.ndarray) -> jnp.ndarray:
+    d = sqn[:, None] + sqn[chunk][None, :] - 2.0 * dots_to_many(factors, chunk)
+    return jnp.minimum(min_dist, jnp.min(d, axis=1))
+
+
+def min_sq_dist_to(factors: Factors, sqn: jnp.ndarray,
+                   labeled_idxs: np.ndarray,
+                   chunk_size: int = 1024) -> jnp.ndarray:
+    """min_j in labeled ||g_i - g_j||^2 for all i, blocked so the live
+    [N, chunk] tile stays small (the O(N^2) escape the reference lacks)."""
+    n = sqn.shape[0]
+    min_dist = jnp.full((n,), jnp.inf, dtype=jnp.float32)
+    labeled_idxs = np.asarray(labeled_idxs)
+    for start in range(0, len(labeled_idxs), chunk_size):
+        chunk = labeled_idxs[start:start + chunk_size]
+        if len(chunk) < chunk_size:  # pad with repeats: min is unaffected
+            chunk = np.concatenate(
+                [chunk, np.repeat(chunk[:1], chunk_size - len(chunk))])
+        min_dist = _min_dist_chunk(factors, sqn, jnp.asarray(chunk), min_dist)
+    return min_dist
+
+
+@functools.partial(jax.jit, static_argnames=("budget", "randomize"))
+def _kcenter_scan(factors: Factors, sqn: jnp.ndarray, min_dist: jnp.ndarray,
+                  selectable: jnp.ndarray, budget: int, randomize: bool,
+                  key: jax.Array) -> jnp.ndarray:
+    """The greedy loop as one scan.  ``selectable`` is 1.0 on unlabeled
+    rows; labeled rows have min_dist ~ 0 so the deterministic argmax never
+    picks them (mirroring the reference, which also relies on that)."""
+
+    def step(carry, key):
+        min_dist, selectable = carry
+        if randomize:
+            # k-means++ D^2 draw over unlabeled rows; if every unlabeled
+            # distance is 0 the reference degenerates to a uniform draw via
+            # its +=1e-5 retry loop (coreset_sampler.py:83-92).
+            p = jnp.clip(min_dist, 0.0, None) * selectable
+            total = jnp.sum(p)
+            weights = jnp.where(total > 0, p, selectable)
+            idx = jax.random.categorical(key, jnp.log(weights))
+        else:
+            # The reference relies on picked rows having min_dist == 0 to
+            # avoid re-selection; under float32 the incremental update can
+            # leave a tiny positive residual on dense pools, so mask
+            # explicitly — same selection, no duplicate risk.
+            idx = jnp.argmax(jnp.where(selectable > 0, min_dist, -jnp.inf))
+        d_new = sqn + sqn[idx] - 2.0 * dots_to(factors, idx)
+        min_dist = jnp.minimum(min_dist, d_new)
+        selectable = selectable.at[idx].set(0.0)
+        return (min_dist, selectable), idx
+
+    keys = jax.random.split(key, budget)
+    _, picks = jax.lax.scan(step, (min_dist, selectable), keys)
+    return picks
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _minimax_row(factors: Factors, sqn: jnp.ndarray, block: int = 2048
+                 ) -> jnp.ndarray:
+    """argmin_i max_j ||g_i - g_j||^2 — the reference's deterministic seed
+    when nothing is labeled (coreset_sampler.py:96-100), computed with a
+    blocked scan instead of the full N x N matrix."""
+    n = sqn.shape[0]
+    pad = (-n) % block
+    order = jnp.arange(n + pad) % n
+
+    def body(row_max, cols):
+        d = sqn[:, None] + sqn[cols][None, :] - 2.0 * dots_to_many(
+            factors, cols)
+        return jnp.maximum(row_max, jnp.max(d, axis=1)), None
+
+    row_max, _ = jax.lax.scan(body, jnp.full((n,), -jnp.inf),
+                              order.reshape(-1, block))
+    return jnp.argmin(row_max)
+
+
+def kcenter_greedy(
+    factors: Sequence[np.ndarray],
+    labeled_mask: np.ndarray,
+    budget: int,
+    randomize: bool = False,
+    rng: Optional[np.random.Generator] = None,
+) -> np.ndarray:
+    """Select ``budget`` local row indices by greedy k-center over the
+    factorized embeddings.  Matches coreset_sampler.coreset(:66-105):
+    deterministic mode takes the farthest-point argmax; randomized mode
+    draws with D^2 probabilities.  Returns selections in pick order."""
+    factors = tuple(jnp.asarray(np.asarray(f), dtype=jnp.float32)
+                    for f in factors)
+    labeled_mask = np.asarray(labeled_mask, dtype=bool)
+    n = labeled_mask.shape[0]
+    budget = int(budget)
+    if budget <= 0:
+        return np.zeros(0, dtype=np.int64)
+    if rng is None:
+        rng = np.random.default_rng()
+    key = jax.random.PRNGKey(int(rng.integers(2 ** 31)))
+
+    sqn = self_sq_norms(factors)
+    labeled_idxs = np.flatnonzero(labeled_mask)
+    picks_pre: list = []
+    if len(labeled_idxs) == 0:
+        # Seed point (coreset_sampler.py:95-100): uniform when randomized,
+        # else the minimax row.
+        if randomize:
+            seed_idx = int(rng.integers(n))
+        else:
+            seed_idx = int(_minimax_row(factors, sqn))
+        picks_pre.append(seed_idx)
+        labeled_idxs = np.asarray([seed_idx])
+        budget -= 1
+
+    min_dist = min_sq_dist_to(factors, sqn, labeled_idxs)
+    selectable = np.ones(n, dtype=np.float32)
+    selectable[labeled_idxs] = 0.0
+    if budget > 0:
+        picks = _kcenter_scan(factors, sqn, min_dist,
+                              jnp.asarray(selectable), budget,
+                              bool(randomize), key)
+        picks = np.asarray(picks, dtype=np.int64)
+    else:
+        picks = np.zeros(0, dtype=np.int64)
+    return np.concatenate([np.asarray(picks_pre, dtype=np.int64), picks])
+
+
+def adaptive_avg_pool_matrix(n_in: int, n_out: int) -> np.ndarray:
+    """[n_in, n_out] averaging weights with torch adaptive_avg_pool bin
+    edges: bin o covers [floor(o*In/Out), ceil((o+1)*In/Out)).  Pooling a
+    vector is then ``v @ M`` (badge_sampler.py:41-44 applies the 2-D pool to
+    the rank-1 grad embedding; pooling each factor separately is exact)."""
+    m = np.zeros((n_in, n_out), dtype=np.float32)
+    for o in range(n_out):
+        start = int(np.floor(o * n_in / n_out))
+        end = int(np.ceil((o + 1) * n_in / n_out))
+        m[start:end, o] = 1.0 / (end - start)
+    return m

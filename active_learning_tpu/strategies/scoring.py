@@ -76,6 +76,48 @@ def make_embed_step(model, view: ViewSpec, with_probs: bool = False
     return step
 
 
+def make_badge_step(model, view: ViewSpec, pool_512: bool = False
+                    ) -> Callable:
+    """BADGE gradient-embedding FACTORS (badge_sampler.py:22-48).
+
+    The gradient of CE(logits, argmax logits) w.r.t. the logits is
+    closed-form — softmax(z) - onehot(argmax z) — so no autograd pass is
+    needed (the reference runs torch.autograd.grad per batch,
+    badge_sampler.py:36-37).  The full gradient embedding is the rank-1
+    outer product a (x) e; we return the two factors instead of the [C*D]
+    flattened product (see strategies/kcenter.py for why that is exact).
+
+    ``pool_512``: the PartitionedBADGE variant pools the (C, D) grad
+    embedding with adaptive average pooling to
+    (min(16, C), 512 // min(16, C)) — 16x32=512 dims for ImageNet, 10x51
+    for CIFAR, exactly the reference's ``pool_h = min(POOLING_H, C)`` rule
+    (badge_sampler.py:9-10,41-44).  Pooling a rank-1 matrix factor-wise is
+    exact, so each factor is pooled by its own averaging matrix.
+    """
+    from .kcenter import adaptive_avg_pool_matrix
+
+    @jax.jit
+    def step(variables, batch):
+        x = apply_view(batch["image"], view, train=False)
+        logits, embedding = model.apply(variables, x, train=False,
+                                        return_features=True)
+        logits = logits.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        pred = jnp.argmax(logits, axis=-1)
+        a = probs - jax.nn.one_hot(pred, logits.shape[-1],
+                                   dtype=jnp.float32)
+        e = embedding
+        if pool_512:
+            c, d = a.shape[1], e.shape[1]
+            pool_h = min(16, c)
+            pool_w = int(512 / pool_h)
+            a = a @ jnp.asarray(adaptive_avg_pool_matrix(c, pool_h))
+            e = e @ jnp.asarray(adaptive_avg_pool_matrix(d, pool_w))
+        return {"grad_a": a, "grad_e": e}
+
+    return step
+
+
 def boundary_radii(embedding: jnp.ndarray, kernel: jnp.ndarray,
                    bias: jnp.ndarray) -> Dict[str, jnp.ndarray]:
     """Closed-form distance from each embedding to every one-vs-one decision

@@ -221,13 +221,20 @@ class Trainer:
         round_idx: int = 0,
         weight_paths: Optional[Dict[str, str]] = None,
         metric_cb: Optional[Callable[[str, float, int], None]] = None,
+        batch_hook: Optional[Callable[[int, Dict[str, np.ndarray]], None]]
+        = None,
     ) -> FitResult:
         """Train on the labeled subset with per-epoch validation + early
         stopping (parallel_train_fn, strategy.py:304-381).
 
         ``es_patience == 0`` disables early stopping (parser.py:66-69); in
         that case the final parameters become the "best" (the reference
-        would crash in load_best_ckpt — deliberate fix)."""
+        would crash in load_best_ckpt — deliberate fix).
+
+        ``batch_hook(epoch, host_batch)`` runs after each classifier step —
+        the seam that lets VAAL co-train its VAE/discriminator inside the
+        same epoch loop (the reference overrides the whole
+        parallel_train_fn, vaal_sampler.py:77-183)."""
         use_es = es_patience != 0 and len(eval_idxs) > 0
         labels = train_set.targets[labeled_idxs]
         class_weights = jnp.asarray(self.class_weights(labels))
@@ -242,6 +249,11 @@ class Trainer:
         epochs_run = 0
         for epoch in range(1, n_epoch + 1):
             epochs_run = epoch
+            if hasattr(train_set, "set_epoch"):
+                # Advance disk datasets' per-(seed, epoch, index) crop RNG
+                # (data/imagenet.py); fold the round in so AL rounds don't
+                # replay the same augmentation sequence.
+                train_set.set_epoch(round_idx * (n_epoch + 1) + epoch)
             lr = jnp.float32(self.lr_at(epoch - 1))
             losses = []
             for batch in iterate_batches(
@@ -249,10 +261,15 @@ class Trainer:
                     num_threads=self.cfg.loader_tr.num_workers,
                     prefetch=self.cfg.loader_tr.prefetch):
                 key, sub = jax.random.split(key)
+                sharded = mesh_lib.shard_batch(batch, self.mesh)
                 state, loss = self._train_step(
-                    state, mesh_lib.shard_batch(batch, self.mesh), sub, lr,
-                    class_weights, view=train_set.view)
+                    state, sharded, sub, lr, class_weights,
+                    view=train_set.view)
                 losses.append(loss)
+                if batch_hook is not None:
+                    # Receives the already-sharded device batch — no second
+                    # host->device transfer on the hot path.
+                    batch_hook(epoch, sharded)
             epoch_loss = float(jnp.mean(jnp.stack(losses))) if losses else 0.0
             record = {"epoch": epoch, "lr": float(lr),
                       "train_loss": epoch_loss}

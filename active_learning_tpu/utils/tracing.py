@@ -1,0 +1,60 @@
+"""Tracing and profiling.
+
+The reference's only tracing is ad-hoc ``time()`` deltas printed per phase
+(src/main_al.py:160-178) and per-batch loss prints (strategy.py:274-279).
+Here (SURVEY.md §5): the same per-phase wall-clock timers feed the metrics
+sink (experiment/driver.py), each phase is additionally wrapped in a
+``jax.profiler.TraceAnnotation`` so device traces show query/train/test
+spans, and an opt-in ``profile_dir`` captures a full XLA profiler trace
+(viewable in TensorBoard/XProf) for the whole run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from .logging import get_logger
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Name the enclosed host span in device profiler traces; free when no
+    trace is active."""
+    import jax.profiler
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def phase_timer(name: str, round_idx: int, sink=None,
+                logger=None) -> Iterator[None]:
+    """Wall-clock a phase, log it, and emit ``rd_{name}`` to the metrics
+    sink — the reference's per-phase prints (main_al.py:160-178) with the
+    profiler annotation added."""
+    logger = logger or get_logger()
+    start = time.time()
+    with annotate(f"{name}/rd{round_idx}"):
+        yield
+    seconds = time.time() - start
+    logger.info(f"Rd {round_idx} {name} is {seconds:.3f}s")
+    if sink is not None:
+        sink.log_metric(f"rd_{name}", seconds, step=round_idx)
+
+
+@contextlib.contextmanager
+def profiler_session(profile_dir: Optional[str]) -> Iterator[None]:
+    """Capture an XLA profiler trace under ``profile_dir`` (None = no-op).
+    View with TensorBoard's profile plugin / XProf."""
+    if not profile_dir:
+        yield
+        return
+    import jax.profiler
+    get_logger().info(f"Capturing profiler trace to {profile_dir}")
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        get_logger().info(f"Profiler trace written to {profile_dir}")

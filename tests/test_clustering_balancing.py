@@ -1,0 +1,108 @@
+"""MarginClustering + Balancing sampler tests (8-device CPU mesh)."""
+
+import numpy as np
+
+from helpers import make_strategy
+
+
+class TestMarginClustering:
+    def test_round_robin_covers_small_clusters_first(self):
+        s = make_strategy("MarginClusteringSampler", n_train=128)
+        got, cost = s.query(10)
+        assert cost == 10 and np.unique(got).size == 10
+        assert not s.pool.labeled[got].any()
+        assert not np.isin(got, s.pool.eval_idxs).any()
+        # Cache carries forward the unqueried assignments.
+        n_avail = len(s.available_query_idxs(shuffle=False))
+        assert s.cluster_assignment is not None
+        assert len(s.cluster_assignment) == n_avail - 10
+
+    def test_cluster_cache_reused_across_rounds(self):
+        s = make_strategy("MarginClusteringSampler", n_train=128)
+        got, cost = s.query(8)
+        s.update(got, cost)
+        cached = s.cluster_assignment
+        calls = {"n": 0}
+        import sklearn.cluster
+
+        orig = sklearn.cluster.AgglomerativeClustering.fit
+
+        def counting_fit(self_, X):
+            calls["n"] += 1
+            return orig(self_, X)
+
+        sklearn.cluster.AgglomerativeClustering.fit = counting_fit
+        try:
+            got2, cost2 = s.query(8)
+        finally:
+            sklearn.cluster.AgglomerativeClustering.fit = orig
+        assert calls["n"] == 0  # second round reuses the assignment
+        assert cost2 == 8 and not np.isin(got2, got).any()
+        assert len(s.cluster_assignment) == len(cached) - 8
+
+    def test_selects_min_margin_within_cluster(self):
+        """The first pick must be the min-margin member of the smallest
+        cluster (margin_clustering_sampler.py:71-79)."""
+        from sklearn.cluster import AgglomerativeClustering
+        s = make_strategy("MarginClusteringSampler", n_train=128)
+        idxs = s.available_query_idxs(shuffle=False)
+        emb, margins = s.get_embeddings_and_margins(idxs)
+        labels = AgglomerativeClustering(n_clusters=20).fit(emb).labels_
+        ids, counts = np.unique(labels, return_counts=True)
+        smallest = sorted(zip(counts.tolist(), ids.tolist()))[0][1]
+        members = np.flatnonzero(labels == smallest)
+        expected_first = idxs[members[np.argmin(margins[members])]]
+        got, _ = s.query(5)
+        assert got[0] == expected_first
+
+    def test_subset_reclusters_every_round(self):
+        s = make_strategy("MarginClusteringSampler", n_train=128,
+                          subset_unlabeled=40)
+        got, cost = s.query(6)
+        assert cost == 6
+        s.update(got, cost)
+        got2, cost2 = s.query(6)
+        assert cost2 == 6 and not np.isin(got2, got).any()
+
+
+class TestBalancingSampler:
+    def test_balanced_pool_random_path(self):
+        """With a balanced labeled set and a large remaining budget the
+        condition at balancing_sampler.py:83-84 routes to random picks."""
+        s = make_strategy("BalancingSampler", n_train=128, init_pool=0)
+        got, cost = s.query(12)
+        assert cost == 12 and np.unique(got).size == 12
+        assert not np.isin(got, s.pool.eval_idxs).any()
+
+    def test_imbalanced_pool_targets_rare_class(self):
+        """Labeled set heavily skewed away from class 0: the balancing
+        branch should pull picks toward class 0 (nearest-to-rarest-centroid
+        with class-template synthetic data ~= true class)."""
+        s = make_strategy("BalancingSampler", n_train=256, init_pool=0)
+        targets = s.al_set.targets
+        avail = s.available_query_mask()
+        # Label many examples of classes 1..3, none of class 0.
+        skew = np.concatenate([
+            np.flatnonzero((targets == c) & avail)[:12]
+            for c in range(1, s.num_classes)])
+        s.update(skew, len(skew))
+        got, cost = s.query(4)
+        assert cost == 4
+        got_classes = targets[got]
+        # Synthetic classes are template-separated, so nearest-to-rarest
+        # centroid reliably lands in the rare class.
+        assert (got_classes == 0).mean() >= 0.75
+
+    def test_freeze_feature_caches_embeddings(self):
+        s = make_strategy("BalancingSampler", freeze_feature=True)
+        calls = {"n": 0}
+        orig = s.collect_scores
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        s.collect_scores = counting
+        s.query(4)
+        s.query(4)
+        assert calls["n"] == 1

@@ -143,3 +143,23 @@ def test_resume_skips_completed_rounds(tmp_path):
     strategy_2, _ = _run(cfg2, tmp_path, "skip")
     np.testing.assert_array_equal(strategy_2.pool.labeled,
                                   strategy_1.pool.labeled)
+
+
+class TestGenJobs:
+    def test_every_job_parses_and_names_registered_components(self):
+        """The sweep printer must stay in sync with the CLI flag surface
+        and the strategy/arg-pool registries (reference: gen_jobs.py)."""
+        from active_learning_tpu.experiment import cli, gen_jobs
+        from active_learning_tpu.registry import ARG_POOLS
+        from active_learning_tpu.strategies import get_strategy
+
+        jobs = gen_jobs.all_jobs("/data")
+        assert len(jobs) == 38  # 9 + 9 + 10 + 10
+        parser = cli.get_parser()
+        for job in jobs:
+            tokens = job.split()
+            assert tokens[:3] == ["python", "-m", "active_learning_tpu"]
+            ns = parser.parse_args(tokens[3:])
+            cfg = cli.args_to_config(ns)
+            get_strategy(cfg.strategy)  # raises if unregistered
+            ARG_POOLS.get(cfg.arg_pool)

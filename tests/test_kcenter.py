@@ -1,0 +1,235 @@
+"""k-center engine + Coreset/BADGE sampler tests.
+
+The device scan (strategies/kcenter.py) is checked against a NumPy oracle
+that re-implements the reference's greedy loop verbatim
+(coreset_sampler.py:66-105): full N x N squared-L2 matrix, min over labeled
+columns, argmax per step.  The factorized BADGE distances are checked
+against materialized outer products, and the pooling matrices against
+torch's adaptive_avg_pool2d.
+"""
+
+import numpy as np
+import pytest
+
+from active_learning_tpu.strategies.kcenter import (
+    adaptive_avg_pool_matrix, kcenter_greedy, min_sq_dist_to, self_sq_norms)
+
+from helpers import make_strategy
+
+
+def oracle_kcenter(emb, labeled_mask, budget):
+    """The reference's greedy loop (coreset_sampler.py:75-105),
+    deterministic mode."""
+    d = ((emb[:, None, :] - emb[None, :, :]) ** 2).sum(-1)
+    lab = labeled_mask.copy()
+    picks = []
+    for _ in range(budget):
+        if lab.sum() > 0:
+            q = int(d[:, lab].min(axis=1).argmax())
+        else:
+            q = int(d.max(axis=1).argmin())
+        picks.append(q)
+        lab[q] = True
+    return np.asarray(picks)
+
+
+class TestKCenterGreedy:
+    def test_matches_reference_loop(self):
+        rng = np.random.default_rng(0)
+        emb = rng.normal(size=(40, 5)).astype(np.float32)
+        labeled = np.zeros(40, dtype=bool)
+        labeled[rng.choice(40, 6, replace=False)] = True
+        got = kcenter_greedy((emb,), labeled, budget=8, randomize=False,
+                             rng=np.random.default_rng(1))
+        np.testing.assert_array_equal(got, oracle_kcenter(emb, labeled, 8))
+
+    def test_empty_labeled_seed_is_minimax_row(self):
+        rng = np.random.default_rng(2)
+        emb = rng.normal(size=(25, 4)).astype(np.float32)
+        labeled = np.zeros(25, dtype=bool)
+        got = kcenter_greedy((emb,), labeled, budget=5, randomize=False,
+                             rng=np.random.default_rng(3))
+        np.testing.assert_array_equal(got, oracle_kcenter(emb, labeled, 5))
+
+    def test_randomized_structural(self):
+        rng = np.random.default_rng(4)
+        emb = rng.normal(size=(60, 6)).astype(np.float32)
+        labeled = np.zeros(60, dtype=bool)
+        labeled[:10] = True
+        got = kcenter_greedy((emb,), labeled, budget=15, randomize=True,
+                             rng=np.random.default_rng(5))
+        assert len(got) == 15
+        assert np.unique(got).size == 15
+        assert not labeled[got].any()
+        # Same host rng seed -> same JAX key -> same draws.
+        again = kcenter_greedy((emb,), labeled, budget=15, randomize=True,
+                               rng=np.random.default_rng(5))
+        np.testing.assert_array_equal(got, again)
+
+    def test_randomized_prefers_far_points(self):
+        # One far cluster: D^2 weights should select from it first.
+        emb = np.zeros((32, 2), dtype=np.float32)
+        emb[16:] += 100.0
+        labeled = np.zeros(32, dtype=bool)
+        labeled[0] = True
+        got = kcenter_greedy((emb,), labeled, budget=1, randomize=True,
+                             rng=np.random.default_rng(6))
+        assert got[0] >= 16
+
+    def test_blocked_min_dist_matches_dense(self):
+        rng = np.random.default_rng(7)
+        emb = rng.normal(size=(50, 3)).astype(np.float32)
+        labeled_idxs = rng.choice(50, 20, replace=False)
+        import jax.numpy as jnp
+        factors = (jnp.asarray(emb),)
+        got = np.asarray(min_sq_dist_to(factors, self_sq_norms(factors),
+                                        labeled_idxs, chunk_size=7))
+        d = ((emb[:, None, :] - emb[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(got, d[:, labeled_idxs].min(axis=1),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestFactorizedDistances:
+    def test_two_factor_dots_equal_outer_product_dots(self):
+        rng = np.random.default_rng(8)
+        a = rng.normal(size=(12, 5)).astype(np.float32)
+        e = rng.normal(size=(12, 7)).astype(np.float32)
+        g = np.einsum("nc,nd->ncd", a, e).reshape(12, -1)
+        import jax.numpy as jnp
+        factors = (jnp.asarray(a), jnp.asarray(e))
+        np.testing.assert_allclose(np.asarray(self_sq_norms(factors)),
+                                   (g ** 2).sum(1), rtol=1e-4)
+        labeled = np.zeros(12, dtype=bool)
+        labeled[[1, 4]] = True
+        got = kcenter_greedy(factors, labeled, budget=4, randomize=False,
+                             rng=np.random.default_rng(9))
+        np.testing.assert_array_equal(got, oracle_kcenter(g, labeled, 4))
+
+    def test_pool_matrix_matches_torch_adaptive_pool(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+        rng = np.random.default_rng(10)
+        for c, d, ph in [(10, 64, 10), (20, 48, 16)]:
+            pw = int(512 / ph)
+            a = rng.normal(size=(c,)).astype(np.float32)
+            e = rng.normal(size=(d,)).astype(np.float32)
+            g = np.outer(a, e)
+            ref = F.adaptive_avg_pool2d(
+                torch.from_numpy(g)[None], (min(ph, c), min(pw, d)))[0].numpy()
+            pa = a @ adaptive_avg_pool_matrix(c, min(ph, c))
+            pe = e @ adaptive_avg_pool_matrix(d, min(pw, d))
+            np.testing.assert_allclose(np.outer(pa, pe), ref, rtol=1e-5,
+                                       atol=1e-6)
+
+
+def direct_embeddings(strategy, idxs):
+    import jax.numpy as jnp
+    from active_learning_tpu.data.augment import apply_view
+    images = strategy.al_set.gather(idxs)
+    x = apply_view(jnp.asarray(images), strategy.al_set.view, train=False)
+    _, emb = strategy.model.apply(strategy.state.variables, x, train=False,
+                                  return_features=True)
+    return np.asarray(emb)
+
+
+class TestCoresetSampler:
+    def test_matches_oracle_end_to_end(self):
+        s = make_strategy("CoresetSampler", n_train=96)
+        idxs_for_coreset = s.get_idxs_for_coreset()
+        emb = direct_embeddings(s, idxs_for_coreset)
+        labeled = s.already_labeled_mask()[idxs_for_coreset]
+        budget = 7
+        expected = idxs_for_coreset[oracle_kcenter(emb, labeled, budget)]
+        got, cost = s.query(budget)
+        assert cost == budget
+        np.testing.assert_array_equal(got, expected)
+        assert not s.pool.labeled[got].any()
+        assert not np.isin(got, s.pool.eval_idxs).any()
+
+    def test_subset_caps(self):
+        s = make_strategy("CoresetSampler", n_train=96,
+                          subset_labeled=4, subset_unlabeled=20)
+        full, lab, unlab = s.get_idxs_for_coreset(return_sep_idxs=True)
+        assert len(lab) == 4
+        # Unused labeled quota rolls into the unlabeled cap
+        # (coreset_sampler.py:28-34): here both caps bind exactly.
+        assert len(unlab) == 20
+        assert len(full) == 24
+        # query() draws its own (shuffled) subset internally; check the
+        # selection is valid rather than matching the draw above.
+        got, cost = s.query(5)
+        assert cost == 5 and np.unique(got).size == 5
+        assert not s.pool.labeled[got].any()
+        assert not np.isin(got, s.pool.eval_idxs).any()
+
+    def test_freeze_feature_caches_embeddings(self):
+        s = make_strategy("CoresetSampler", freeze_feature=True)
+        calls = {"n": 0}
+        orig = s.get_factors
+
+        def counting(idxs):
+            calls["n"] += 1
+            return orig(idxs)
+
+        s.get_factors = counting
+        s.query(4)
+        s.query(4)
+        assert calls["n"] == 1  # second query served from the cache
+
+    def test_no_cache_without_freeze(self):
+        s = make_strategy("CoresetSampler")
+        s.query(4)
+        assert s._saved_factors is None
+
+
+class TestBADGESampler:
+    def test_grad_factors_match_closed_form(self):
+        import jax
+        import jax.numpy as jnp
+        from active_learning_tpu.data.augment import apply_view
+        s = make_strategy("BADGESampler")
+        avail = s.available_query_idxs(shuffle=False)[:16]
+        out = s.collect_scores(avail, "badge", keys=("grad_a", "grad_e"))
+        images = s.al_set.gather(avail)
+        x = apply_view(jnp.asarray(images), s.al_set.view, train=False)
+        logits, emb = s.model.apply(s.state.variables, x, train=False,
+                                    return_features=True)
+        probs = np.asarray(jax.nn.softmax(logits.astype(jnp.float32), -1))
+        onehot = np.eye(probs.shape[1])[probs.argmax(1)]
+        np.testing.assert_allclose(out["grad_a"], probs - onehot, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(out["grad_e"], np.asarray(emb), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_query_structural(self):
+        s = make_strategy("BADGESampler", n_train=96)
+        got, cost = s.query(9)
+        assert cost == 9 and np.unique(got).size == 9
+        assert not s.pool.labeled[got].any()
+        assert s._saved_factors is None  # BADGE never caches
+
+
+class TestPartitionedSamplers:
+    @pytest.mark.parametrize("name", ["PartitionedCoresetSampler",
+                                      "PartitionedBADGESampler"])
+    def test_query_structural(self, name):
+        s = make_strategy(name, n_train=96, partitions=3)
+        got, cost = s.query(10)
+        assert cost == 10 and np.unique(got).size == 10
+        assert not s.pool.labeled[got].any()
+        assert not np.isin(got, s.pool.eval_idxs).any()
+        np.testing.assert_array_equal(got, np.sort(got))
+
+    def test_partition_split_rule(self):
+        s = make_strategy("PartitionedCoresetSampler", partitions=3)
+        parts = s.generate_partition_idxs_list(np.arange(11))
+        assert [len(p) for p in parts] == [4, 4, 3]
+        assert np.array_equal(np.sort(np.concatenate(parts)), np.arange(11))
+
+    def test_partitioned_matches_plain_when_one_partition(self):
+        a = make_strategy("PartitionedCoresetSampler", n_train=96,
+                          partitions=1)
+        got_a, _ = a.query(6)
+        b = make_strategy("CoresetSampler", n_train=96)
+        got_b, _ = b.query(6)
+        np.testing.assert_array_equal(np.sort(got_a), np.sort(got_b))

@@ -1,0 +1,123 @@
+"""VAAL stack tests (8-device CPU mesh): VAE shapes/losses, co-training
+dynamics, discriminator-score acquisition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from active_learning_tpu.models.vaal import (VAE, Discriminator,
+                                             crop_size_for, random_crop)
+
+from helpers import make_strategy
+
+
+def make_vaal_strategy(**kw):
+    # image_size=16 keeps the VAE valid (4 stride-2 convs need crop % 16
+    # == 0) and the test fast.
+    kw.setdefault("n_train", 96)
+    kw.setdefault("image_size", 16)
+    return make_strategy("VAALSampler", **kw)
+
+
+class TestVAEModel:
+    def test_shapes_roundtrip(self):
+        for crop in (16, 32):
+            vae = VAE(z_dim=8, crop=crop)
+            x = jnp.zeros((4, crop, crop, 3))
+            variables = vae.init(jax.random.PRNGKey(0), x, train=False)
+            (recon, z, mu, logvar), _ = vae.apply(
+                variables, x, jax.random.PRNGKey(1), train=True,
+                mutable=["batch_stats"])
+            assert recon.shape == x.shape
+            assert z.shape == mu.shape == logvar.shape == (4, 8)
+
+    def test_reparameterize_none_key_returns_mu(self):
+        vae = VAE(z_dim=8, crop=16)
+        x = jnp.ones((2, 16, 16, 3))
+        variables = vae.init(jax.random.PRNGKey(0), x, train=False)
+        _, z, mu, _ = vae.apply(variables, x, None, train=False)
+        np.testing.assert_array_equal(np.asarray(z), np.asarray(mu))
+
+    def test_discriminator_outputs_probabilities(self):
+        disc = Discriminator(z_dim=8)
+        z = jnp.asarray(np.random.default_rng(0).normal(size=(6, 8)),
+                        dtype=jnp.float32)
+        params = disc.init(jax.random.PRNGKey(0), z)
+        p = np.asarray(disc.apply(params, z))
+        assert p.shape == (6, 1)
+        assert (p > 0).all() and (p < 1).all()
+
+    def test_crop_rules(self):
+        assert crop_size_for(224) == 64
+        assert crop_size_for(64) == 64
+        assert crop_size_for(32) == 32
+        x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+        # Small inputs pass through whole.
+        np.testing.assert_array_equal(
+            np.asarray(random_crop(x, 16, jax.random.PRNGKey(0))),
+            np.asarray(x))
+        # Large inputs: one shared window, correct size.
+        big = jnp.arange(2 * 12 * 12 * 3, dtype=jnp.float32
+                         ).reshape(2, 12, 12, 3)
+        out = np.asarray(random_crop(big, 8, jax.random.PRNGKey(0)))
+        assert out.shape == (2, 8, 8, 3)
+
+
+class TestVAALTraining:
+    def test_cotrain_updates_all_three_models(self):
+        s = make_vaal_strategy(n_epoch=1)
+        before_cls = jax.tree.map(np.asarray, s.state.params)
+        before_vae = jax.tree.map(np.asarray, s.vaal_state.vae_params)
+        before_d = jax.tree.map(np.asarray, s.vaal_state.d_params)
+        s.train()
+
+        def changed(a, b):
+            return any(not np.allclose(x, y) for x, y in zip(
+                jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)))
+
+        assert changed(before_cls, jax.tree.map(np.asarray, s.state.params))
+        assert changed(before_vae,
+                       jax.tree.map(np.asarray, s.vaal_state.vae_params))
+        assert changed(before_d,
+                       jax.tree.map(np.asarray, s.vaal_state.d_params))
+        # Everything stayed finite through the 3-step updates.
+        for leaf in jax.tree_util.tree_leaves(s.vaal_state.vae_params):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_query_returns_lowest_discriminator_scores(self):
+        s = make_vaal_strategy(n_epoch=1)
+        s.train()
+        idxs = s.available_query_idxs(shuffle=False)
+        variables = {"vae_params": s.vaal_state.vae_params,
+                     "vae_stats": s.vaal_state.vae_stats,
+                     "d_params": s.vaal_state.d_params}
+        from active_learning_tpu.strategies import scoring
+        out = scoring.collect_pool(
+            s.al_set, idxs, s._score_batch_size(), s._score_step,
+            variables, s.mesh)
+        expected = idxs[np.argsort(out["d_score"], kind="stable")[:6]]
+        got, cost = s.query(6)
+        assert cost == 6
+        np.testing.assert_array_equal(got, expected)
+        assert not s.pool.labeled[got].any()
+
+    def test_round_reinit_resets_vaal_state(self):
+        s = make_vaal_strategy()
+        first = jax.tree.map(np.asarray, s.vaal_state.vae_params)
+        s.init_network_weights()
+        second = jax.tree.map(np.asarray, s.vaal_state.vae_params)
+        leaves1 = jax.tree_util.tree_leaves(first)
+        leaves2 = jax.tree_util.tree_leaves(second)
+        assert any(not np.allclose(a, b)
+                   for a, b in zip(leaves1, leaves2))
+
+    def test_e2e_two_rounds(self):
+        s = make_vaal_strategy(n_epoch=1)
+        s.train()
+        got, cost = s.query(8)
+        s.update(got, cost)
+        assert s.pool.num_labeled == 8 + 8
+        s.init_network_weights()
+        s.train()
+        got2, cost2 = s.query(8)
+        assert not np.isin(got2, got).any()
