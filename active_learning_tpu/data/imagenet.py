@@ -66,12 +66,22 @@ def random_resized_crop_params(h: int, w: int, rng: np.random.Generator,
 
 
 class _DiskImageDataset(Dataset):
-    """Shared decode/transform logic for disk-backed datasets."""
+    """Shared decode/transform logic for disk-backed datasets.
+
+    Two decode paths, same transform semantics:
+      * native (default): batch JPEG decode + crop + bilinear resize in the
+        C++ component (native/decode.cpp) with its own thread pool — crop
+        rectangles are still computed here in Python from the per-(seed,
+        epoch, index) RNG, so randomness is identical across paths;
+      * PIL fallback: per-image decode, used when the native library is
+        unavailable or a file isn't a baseline JPEG.
+    """
 
     def __init__(self, paths: List[str], targets: Sequence[int],
                  num_classes: int, view: ViewSpec, train_transform: bool,
                  image_size: int = 224, resize_size: int = 256,
-                 limit: Optional[int] = None, seed: int = 0):
+                 limit: Optional[int] = None, seed: int = 0,
+                 use_native: bool = True, decode_threads: int = 4):
         self.paths = paths
         self.targets = np.asarray(targets, dtype=np.int64)
         self.num_classes = num_classes
@@ -82,6 +92,12 @@ class _DiskImageDataset(Dataset):
         self._limit = limit
         self._seed = seed
         self._epoch = 0
+        self._use_native = use_native and os.environ.get(
+            "AL_TPU_NO_NATIVE") != "1"
+        self.decode_threads = decode_threads
+        # (height, width) per index, filled on first native touch — image
+        # files are immutable, so headers are parsed at most once.
+        self._dims_cache: dict = {}
         self.image_shape = (image_size, image_size, 3)
 
     def __len__(self) -> int:
@@ -121,9 +137,74 @@ class _DiskImageDataset(Dataset):
             img = img.crop((left, top, left + s, top + s))
         return np.asarray(img, dtype=np.uint8)
 
-    def gather(self, idxs: np.ndarray) -> np.ndarray:
+    def _crop_rect(self, h: int, w: int, index: int
+                   ) -> Tuple[int, int, int, int]:
+        """(top, left, ch, cw) for one image under the current view."""
+        if self.train_transform:
+            rng = np.random.default_rng(
+                (self._seed, self._epoch, int(index)))
+            return random_resized_crop_params(h, w, rng)
+        # Resize(short=256) + CenterCrop(224) == centered crop of
+        # 224 * short/256 in the original image, bilinear-resized.
+        short = min(h, w)
+        box = int(round(self.image_size * short / self.resize_size))
+        return (h - box) // 2, (w - box) // 2, box, box
+
+    def _native_dims(self, idxs: np.ndarray) -> Optional[np.ndarray]:
+        """Per-index (h, w) via the header cache; -1 rows mean libjpeg
+        can't handle that file (PIL decodes it instead)."""
+        from . import native
+        missing = [int(i) for i in idxs if int(i) not in self._dims_cache]
+        if missing:
+            dims = native.jpeg_dims([self.paths[i] for i in missing],
+                                    self.decode_threads)
+            if dims is None:
+                return None
+            for i, hw in zip(missing, dims):
+                self._dims_cache[i] = (int(hw[0]), int(hw[1]))
+        return np.asarray([self._dims_cache[int(i)] for i in idxs],
+                          dtype=np.int32)
+
+    def _gather_native(self, idxs: np.ndarray) -> Optional[np.ndarray]:
+        """Batch decode via native/decode.cpp.  Files the native path can't
+        handle (non-JPEG extension, CMYK encodings, parse failures) fall
+        back to PIL INDIVIDUALLY — one odd file never disables the fast
+        path for the rest of the dataset."""
+        from . import native
+        if native.load() is None:
+            self._use_native = False  # no library: skip the probe forever
+            return None
+        paths = [self.paths[int(i)] for i in idxs]
+        is_jpeg = np.asarray(
+            [p.lower().endswith((".jpg", ".jpeg")) for p in paths])
+        dims = self._native_dims(idxs) if is_jpeg.any() else None
+        if dims is None:
+            return None
+        ok = is_jpeg & (dims[:, 0] > 0)
         out = np.empty((len(idxs), *self.image_shape), dtype=np.uint8)
-        for i, idx in enumerate(np.asarray(idxs)):
+        if ok.any():
+            sel = np.flatnonzero(ok)
+            rects = np.asarray(
+                [self._crop_rect(*self._dims_cache[int(idxs[i])],
+                                 int(idxs[i])) for i in sel],
+                dtype=np.int32)
+            decoded, failed = native.decode_crop_resize(
+                [paths[i] for i in sel], rects, self.image_size,
+                self.decode_threads)
+            out[sel] = decoded
+            ok[sel[failed]] = False
+        for i in np.flatnonzero(~ok):
+            out[i] = self._decode_one(paths[i], int(idxs[i]))
+        return out
+
+    def gather(self, idxs: np.ndarray) -> np.ndarray:
+        idxs = np.asarray(idxs)
+        if self._use_native:
+            out = self._gather_native(idxs)
+            if out is not None:
+                return out
+        out = np.empty((len(idxs), *self.image_shape), dtype=np.uint8)
+        for i, idx in enumerate(idxs):
             out[i] = self._decode_one(self.paths[int(idx)], int(idx))
         return out
 

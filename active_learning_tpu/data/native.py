@@ -1,0 +1,128 @@
+"""ctypes bindings for the native data-path component (native/decode.cpp).
+
+The C++ side does the bandwidth-heavy work — libjpeg decode, crop, bilinear
+resize, straight into one preallocated uint8 batch buffer with an internal
+thread pool.  Crop-rectangle RANDOMNESS stays in Python
+(data/imagenet.py) so augmentation remains a pure function of
+(seed, epoch, index).
+
+The library is built lazily with g++ on first use and cached under
+native/build/; if the toolchain or libjpeg is missing, callers fall back to
+the PIL path (``load() returns None``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libaldata.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "decode.cpp")
+    if not os.path.exists(src):
+        return False
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    # Compile to a process-unique temp name, then rename: the publish is
+    # atomic, so concurrent first-users can never dlopen a half-written .so.
+    tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", src,
+           "-o", tmp, "-ljpeg", "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO_PATH)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        get_logger().warning(
+            f"native decode build failed ({e!r}); using the PIL path")
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it if needed; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO_PATH) and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            get_logger().warning(f"native decode load failed ({e!r})")
+            _load_failed = True
+            return None
+        lib.al_jpeg_dims.restype = ctypes.c_int
+        lib.al_jpeg_dims.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        lib.al_decode_crop_resize.restype = ctypes.c_int
+        lib.al_decode_crop_resize.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def _path_array(paths: Sequence[str]):
+    arr = (ctypes.c_char_p * len(paths))()
+    arr[:] = [p.encode() for p in paths]
+    return arr
+
+
+def jpeg_dims(paths: Sequence[str], n_threads: int = 4
+              ) -> Optional[np.ndarray]:
+    """[N, 2] (height, width) from JPEG headers; rows are (-1, -1) for
+    files libjpeg can't parse (caller decides the fallback).  None if the
+    native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    out = np.empty((len(paths), 2), dtype=np.int32)
+    lib.al_jpeg_dims(
+        _path_array(paths), len(paths),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n_threads)
+    return out
+
+
+def decode_crop_resize(paths: Sequence[str], rects: np.ndarray,
+                       out_size: int, n_threads: int = 4):
+    """Decode + crop (rects[i] = top, left, ch, cw) + bilinear resize into
+    a uint8 [N, out_size, out_size, 3] batch.  Returns (batch, failed_mask)
+    — failed rows (e.g. CMYK JPEGs) are zeroed for the caller to re-decode
+    individually — or None if the native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    rects = np.ascontiguousarray(rects, dtype=np.int32)
+    assert rects.shape == (len(paths), 4)
+    out = np.empty((len(paths), out_size, out_size, 3), dtype=np.uint8)
+    failed = np.zeros(len(paths), dtype=np.uint8)
+    lib.al_decode_crop_resize(
+        _path_array(paths), len(paths),
+        rects.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), out_size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        failed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n_threads)
+    return out, failed.astype(bool)

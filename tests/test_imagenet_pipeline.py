@@ -99,3 +99,97 @@ class TestThreadedPipeline:
         gen = iterate_batches(ds, np.arange(len(ds)), 2, num_threads=2)
         next(gen)
         gen.close()  # must not deadlock or leak
+
+
+class TestNativeDecode:
+    def test_identity_decode_matches_pil_exactly(self, tmp_path):
+        """Whole-image rect + same-size output is a pure decode: must match
+        PIL pixel-for-pixel (both are IJG-compatible JPEG decoders)."""
+        PIL = pytest.importorskip("PIL.Image")
+        from active_learning_tpu.data import native
+        if native.load() is None:
+            pytest.skip("native decode unavailable")
+        rng = np.random.default_rng(1)
+        # Smooth image: JPEG is lossy, but decode-vs-decode is exact.
+        base = np.linspace(0, 255, 48 * 48 * 3).reshape(48, 48, 3)
+        arr = (base + rng.normal(0, 4, base.shape)).clip(0, 255).astype(
+            np.uint8)
+        p = tmp_path / "a.jpg"
+        PIL.fromarray(arr).save(p, quality=90)
+
+        dims = native.jpeg_dims([str(p)])
+        np.testing.assert_array_equal(dims, [[48, 48]])
+        out, failed = native.decode_crop_resize(
+            [str(p)], np.asarray([[0, 0, 48, 48]], dtype=np.int32), 48)
+        assert not failed.any()
+        pil = np.asarray(PIL.open(p).convert("RGB"))
+        np.testing.assert_array_equal(out[0], pil)
+
+    def test_dataset_native_and_pil_paths_agree(self, jpeg_tree):
+        """Same crop rects (RNG lives in Python), near-identical pixels —
+        only the resize filter differs between the two paths."""
+        from active_learning_tpu.data import native
+        if native.load() is None:
+            pytest.skip("native decode unavailable")
+        nat = make_ds(jpeg_tree, train=True, seed=3)
+        pil = make_ds(jpeg_tree, train=True, seed=3)
+        pil._use_native = False
+        assert nat._use_native
+        idxs = np.asarray([0, 5, 9])
+        a = nat.gather(idxs)
+        b = pil.gather(idxs)
+        assert a.shape == b.shape == (3, 224, 224, 3)
+        # Same crop windows: the images should be nearly identical, not
+        # merely correlated.
+        diff = np.abs(a.astype(np.int32) - b.astype(np.int32)).mean()
+        assert diff < 12.0, f"native/PIL paths diverged: mean abs {diff}"
+
+    def test_val_transform_native_matches_shape_and_determinism(
+            self, jpeg_tree):
+        from active_learning_tpu.data import native
+        if native.load() is None:
+            pytest.skip("native decode unavailable")
+        ds = make_ds(jpeg_tree, train=False)
+        a = ds.gather(np.asarray([2]))
+        b = ds.gather(np.asarray([2]))
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (1, 224, 224, 3)
+
+    def test_non_jpeg_falls_back_to_pil(self, tmp_path):
+        PIL = pytest.importorskip("PIL.Image")
+        root = tmp_path / "pngs" / "class0"
+        os.makedirs(root)
+        arr = np.zeros((40, 40, 3), dtype=np.uint8)
+        PIL.fromarray(arr).save(root / "img.png")
+        ds = ImageFolderDataset(str(tmp_path / "pngs"),
+                                ViewSpec(IMAGENET_NORM, augment=False),
+                                False, num_classes=1)
+        out = ds.gather(np.asarray([0]))
+        assert out.shape == (1, 224, 224, 3)
+
+    def test_cmyk_jpeg_falls_back_per_file_without_disabling_native(
+            self, tmp_path):
+        """Real ImageNet contains a handful of CMYK JPEGs libjpeg can't
+        emit as RGB; they must fall back to PIL individually while the
+        rest of the batch stays on the native path."""
+        PIL = pytest.importorskip("PIL.Image")
+        from active_learning_tpu.data import native
+        if native.load() is None:
+            pytest.skip("native decode unavailable")
+        root = tmp_path / "mixed" / "class0"
+        os.makedirs(root)
+        rng = np.random.default_rng(0)
+        for i in range(3):
+            arr = rng.integers(0, 256, size=(60, 60, 3), dtype=np.uint8)
+            PIL.fromarray(arr).save(root / f"a{i}.jpg")
+        PIL.fromarray(
+            rng.integers(0, 256, size=(60, 60, 4), dtype=np.uint8),
+            mode="CMYK").save(root / "cmyk.jpg")
+        ds = ImageFolderDataset(str(tmp_path / "mixed"),
+                                ViewSpec(IMAGENET_NORM, augment=False),
+                                False, num_classes=1)
+        out = ds.gather(np.arange(4))
+        assert out.shape == (4, 224, 224, 3)
+        assert ds._use_native  # one odd file must not kill the fast path
+        # The CMYK slot decoded through PIL is not all zeros.
+        assert all(out[i].any() for i in range(4))
