@@ -91,6 +91,11 @@ class TrainConfig:
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     pretrained: PretrainedConfig = dataclasses.field(default_factory=PretrainedConfig)
     imbalanced_training: bool = False
+    # Device-resident epochs for in-memory datasets (one jitted scan per
+    # epoch instead of per-batch dispatch).  None = auto (on when the
+    # images fit in HBM and the labeled set is large enough to amortize
+    # the extra compile), True = force on, False = host-batched path.
+    device_resident: Optional[bool] = None
 
     @property
     def has_pretrained(self) -> bool:

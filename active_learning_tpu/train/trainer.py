@@ -96,6 +96,7 @@ class Trainer:
         self.train_bn = train_bn
         self.n_devices = mesh.devices.size
         self._train_step = self._build_train_step()
+        self._epoch_scan: Optional[Callable] = None  # built on first use
         self._eval_steps: Dict[Any, Callable] = {}
 
     # -- setup -----------------------------------------------------------
@@ -172,6 +173,103 @@ class Trainer:
                 self.model, view, self.num_classes)
         return self._eval_steps[view]
 
+    def _build_epoch_scan(self):
+        """One jitted call = one full epoch over device-resident data.
+
+        The host-batched path dispatches one jitted step per batch — fine
+        when gather/decode is the bottleneck (disk datasets), pure dispatch
+        overhead when the whole labeled set already sits in HBM (CIFAR
+        scale: 50k x 32x32x3 uint8 = 150 MB).  Here the epoch is a single
+        ``lax.scan`` over a [steps, batch] index matrix: per step an
+        on-device gather + sharding constraint reproduces exactly what
+        ``shard_batch`` commits on the host path, and the PRNG-key chain
+        (split once per batch) matches it bit for bit, so both paths give
+        identical parameters.
+        """
+        train_step = self._train_step
+        mesh = self.mesh
+
+        @functools.partial(jax.jit, static_argnames=("view",),
+                           donate_argnums=(0,))
+        def epoch_scan(state, images, labels, idx_mat, mask_mat, valid,
+                       key, lr, class_weights, view):
+            batch_sharding = mesh_lib.batch_sharding(mesh)
+
+            def body(carry, inp):
+                state, key = carry
+                idxs, mask, v = inp
+                new_key, sub = jax.random.split(key)
+                batch = {
+                    "image": jax.lax.with_sharding_constraint(
+                        images[idxs], batch_sharding),
+                    "label": labels[idxs],
+                    "mask": mask,
+                }
+                new_state, loss = train_step(state, batch, sub, lr,
+                                             class_weights, view=view)
+                # Bucket-padding steps (v == 0) are fully selected away —
+                # state, key chain, and loss — so the scan is numerically
+                # identical to running exactly the real steps.
+                state = jax.tree.map(
+                    lambda n, o: jnp.where(v > 0, n, o), new_state, state)
+                key = jnp.where(v > 0, new_key, key)
+                return (state, key), loss * v
+
+            (state, key), losses = jax.lax.scan(
+                body, (state, key), (idx_mat, mask_mat, valid))
+            return state, key, losses
+
+        return epoch_scan
+
+    # Steps (and uploaded rows) are bucketed to multiples of this so the
+    # epoch scan compiles once per BUCKET, not once per AL round as the
+    # labeled set grows; the padding steps are masked out inside the scan.
+    STEP_BUCKET = 16
+
+    def _device_resident_arrays(self, train_set: Dataset,
+                                labeled_idxs: np.ndarray, batch_size: int):
+        """Upload the labeled subset once, padded up to the row bucket so
+        consecutive rounds reuse the same compiled scan (replicated; the
+        per-step gather output is what gets data-sharded)."""
+        images = train_set.gather(labeled_idxs)
+        labels = train_set.targets[labeled_idxs].astype(np.int32)
+        row_bucket = self.STEP_BUCKET * batch_size
+        padded = -(-len(labeled_idxs) // row_bucket) * row_bucket
+        pad = padded - len(labeled_idxs)
+        if pad:
+            images = np.concatenate(
+                [images, np.zeros((pad, *images.shape[1:]), images.dtype)])
+            labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
+        return (mesh_lib.replicate(jnp.asarray(images), self.mesh),
+                mesh_lib.replicate(jnp.asarray(labels), self.mesh))
+
+    @classmethod
+    def _epoch_index_matrix(cls, n: int, batch_size: int,
+                            rng: np.random.Generator):
+        """Shuffled fixed-shape [steps, batch] LOCAL index matrix, padding
+        mask, and per-step validity — consuming the rng exactly like the
+        host path's batch_index_lists(shuffle=True)."""
+        perm = rng.permutation(np.arange(n))
+        steps_real = num_batches(n, batch_size)
+        pad = steps_real * batch_size - n
+        if pad:
+            # Pad with the last batch's first row — the exact rows
+            # gather_batch pads with, so BN batch statistics match the
+            # host-batched path bit for bit.
+            perm = np.concatenate(
+                [perm, np.repeat(perm[(steps_real - 1) * batch_size], pad)])
+        mask = np.ones(steps_real * batch_size, dtype=np.float32)
+        if pad:
+            mask[n:] = 0.0
+        steps = -(-steps_real // cls.STEP_BUCKET) * cls.STEP_BUCKET
+        idx_mat = np.zeros((steps, batch_size), dtype=np.int32)
+        mask_mat = np.zeros((steps, batch_size), dtype=np.float32)
+        idx_mat[:steps_real] = perm.reshape(steps_real, batch_size)
+        mask_mat[:steps_real] = mask.reshape(steps_real, batch_size)
+        valid = np.zeros(steps, dtype=np.float32)
+        valid[:steps_real] = 1.0
+        return idx_mat, mask_mat, valid, steps_real
+
     # -- class weights ---------------------------------------------------
 
     def class_weights(self, labels: np.ndarray) -> np.ndarray:
@@ -241,6 +339,27 @@ class Trainer:
         state = self.reinit_optimizer(state)
         bs = self.padded_batch_size(self.cfg.loader_tr.batch_size)
 
+        # Device-resident epochs: when the labeled subset is an in-memory
+        # array that fits in HBM and no per-batch hook needs host batches,
+        # upload it once and run each epoch as ONE jitted scan — identical
+        # numerics (tests/test_trainer_parallel.py), zero per-batch
+        # dispatch.  Auto mode only engages once the labeled set is large
+        # enough for dispatch overhead to matter: the scan is a second
+        # sizeable XLA compile, a bad trade for a few-batch round.
+        dr_possible = (batch_hook is None
+                       and isinstance(getattr(train_set, "images", None),
+                                      np.ndarray)
+                       and train_set.images.nbytes <= 2 ** 31)
+        use_dr = dr_possible and (
+            self.cfg.device_resident is True
+            or (self.cfg.device_resident is None
+                and len(labeled_idxs) >= 2048))
+        if use_dr:
+            dr_images, dr_labels = self._device_resident_arrays(
+                train_set, labeled_idxs, bs)
+            if self._epoch_scan is None:
+                self._epoch_scan = self._build_epoch_scan()
+
         best_perf, best_epoch, es_count = 0.0, 0, 0
         best_variables = None
         history: List[Dict[str, float]] = []
@@ -255,22 +374,32 @@ class Trainer:
                 # replay the same augmentation sequence.
                 train_set.set_epoch(round_idx * (n_epoch + 1) + epoch)
             lr = jnp.float32(self.lr_at(epoch - 1))
-            losses = []
-            for batch in iterate_batches(
-                    train_set, labeled_idxs, bs, shuffle=True, rng=rng,
-                    num_threads=self.cfg.loader_tr.num_workers,
-                    prefetch=self.cfg.loader_tr.prefetch):
-                key, sub = jax.random.split(key)
-                sharded = mesh_lib.shard_batch(batch, self.mesh)
-                state, loss = self._train_step(
-                    state, sharded, sub, lr, class_weights,
-                    view=train_set.view)
-                losses.append(loss)
-                if batch_hook is not None:
-                    # Receives the already-sharded device batch — no second
-                    # host->device transfer on the hot path.
-                    batch_hook(epoch, sharded)
-            epoch_loss = float(jnp.mean(jnp.stack(losses))) if losses else 0.0
+            if use_dr:
+                idx_mat, mask_mat, valid, steps_real = \
+                    self._epoch_index_matrix(len(labeled_idxs), bs, rng)
+                state, key, losses = self._epoch_scan(
+                    state, dr_images, dr_labels, jnp.asarray(idx_mat),
+                    jnp.asarray(mask_mat), jnp.asarray(valid), key, lr,
+                    class_weights, view=train_set.view)
+                epoch_loss = float(jnp.sum(losses)) / steps_real
+            else:
+                losses = []
+                for batch in iterate_batches(
+                        train_set, labeled_idxs, bs, shuffle=True, rng=rng,
+                        num_threads=self.cfg.loader_tr.num_workers,
+                        prefetch=self.cfg.loader_tr.prefetch):
+                    key, sub = jax.random.split(key)
+                    sharded = mesh_lib.shard_batch(batch, self.mesh)
+                    state, loss = self._train_step(
+                        state, sharded, sub, lr, class_weights,
+                        view=train_set.view)
+                    losses.append(loss)
+                    if batch_hook is not None:
+                        # Receives the already-sharded device batch — no
+                        # second host->device transfer on the hot path.
+                        batch_hook(epoch, sharded)
+                epoch_loss = (float(jnp.mean(jnp.stack(losses)))
+                              if losses else 0.0)
             record = {"epoch": epoch, "lr": float(lr),
                       "train_loss": epoch_loss}
 
