@@ -34,7 +34,7 @@ from flax import struct
 from ..config import TrainConfig
 from ..data.augment import apply_view
 from ..data.core import Dataset
-from ..data.pipeline import iterate_batches
+from ..data.pipeline import iterate_batches, num_batches
 from ..parallel import mesh as mesh_lib
 from ..utils.logging import get_logger
 from . import checkpoint as ckpt_lib
