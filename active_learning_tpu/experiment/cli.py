@@ -69,7 +69,10 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--partitions", type=int, default=1)
     # VAAL (parser.py:81-92)
     p.add_argument("--vae_latent_dim", type=int, default=64)
-    p.add_argument("--adversary_param", type=float, default=10.0)
+    # Reference spelling (parser.py:84); --adversary_param kept as an alias
+    # for commands written against earlier versions of this CLI.
+    p.add_argument("--vaal_adversary_param", "--adversary_param",
+                   dest="vaal_adversary_param", type=float, default=10.0)
     p.add_argument("--lr_vae", type=float, default=5e-5)
     p.add_argument("--lr_discriminator", type=float, default=1e-3)
     # Seeds / mesh (TPU-specific)
@@ -112,7 +115,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         partitions=args.partitions,
         vaal=VAALConfig(
             vae_latent_dim=args.vae_latent_dim,
-            adversary_param=args.adversary_param,
+            adversary_param=args.vaal_adversary_param,
             lr_vae=args.lr_vae,
             lr_discriminator=args.lr_discriminator),
         run_seed=args.run_seed,

@@ -11,14 +11,15 @@ LOGGER_NAME = "ActiveLearning"
 
 
 class MillisecondFormatter(logging.Formatter):
-    converter = dt.datetime.fromtimestamp
+    """Render timestamps through ``datetime`` so ``%f`` (sub-second
+    precision) works in ``datefmt``; without a ``datefmt``, fall back to
+    ISO date-time at millisecond resolution."""
 
     def formatTime(self, record, datefmt=None):
-        ct = self.converter(record.created)
-        if datefmt:
-            return ct.strftime(datefmt)
-        t = ct.strftime("%Y-%m-%d %H:%M:%S")
-        return "%s,%03d" % (t, record.msecs)
+        created = dt.datetime.fromtimestamp(record.created)
+        if datefmt is None:
+            return created.isoformat(sep=" ", timespec="milliseconds")
+        return created.strftime(datefmt)
 
 
 def get_logger() -> logging.Logger:

@@ -163,3 +163,16 @@ class TestGenJobs:
             cfg = cli.args_to_config(ns)
             get_strategy(cfg.strategy)  # raises if unregistered
             ARG_POOLS.get(cfg.arg_pool)
+
+    def test_vaal_adversary_flag_uses_reference_spelling(self):
+        """Published VAAL commands use --vaal_adversary_param
+        (reference parser.py:84); both that and the short alias must
+        reach VAALConfig.adversary_param."""
+        from active_learning_tpu.experiment import cli
+
+        parser = cli.get_parser()
+        for flag in ("--vaal_adversary_param", "--adversary_param"):
+            ns = parser.parse_args(
+                ["--dataset", "synthetic", "--strategy", "VAALSampler",
+                 flag, "2.5"])
+            assert cli.args_to_config(ns).vaal.adversary_param == 2.5
