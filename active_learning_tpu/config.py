@@ -188,6 +188,15 @@ class ExperimentConfig:
     # main_al.py:96; -1 = all local devices)
     num_devices: int = -1
 
+    # Multi-host (DCN): jax.distributed rendezvous, the run-once equivalent
+    # of the reference's per-round NCCL process group (strategy.py:288-315).
+    # All None = single process, or TPU-pod auto-discovery when only
+    # num_processes is given.  ckpt_path must be a shared filesystem on
+    # multi-host runs (only process 0 writes; every process reads).
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
     def resolved_init_pool_size(self) -> int:
         if self.init_pool_size == -1:
             return int(self.round_budget)

@@ -38,24 +38,48 @@ def batch_index_lists(idxs: np.ndarray, batch_size: int,
     return [idxs[i:i + batch_size] for i in range(0, n, batch_size)]
 
 
-def gather_batch(dataset: Dataset, batch_idxs: np.ndarray,
-                 batch_size: int) -> Dict[str, np.ndarray]:
-    """Gather one fixed-shape batch: uint8 images + labels + pool indices +
-    validity mask (0.0 on padding rows)."""
-    actual = len(batch_idxs)
-    images = dataset.gather(batch_idxs)
-    labels = dataset.targets[batch_idxs]
-    mask = np.ones(actual, dtype=np.float32)
+def padded_batch_layout(batch_idxs: np.ndarray, batch_size: int):
+    """The deterministic global row layout of one fixed-shape batch:
+    (padded index array, validity mask).  Padding rows repeat the batch's
+    first example (mask 0.0), so every process computes the identical
+    layout from the same index math — no cross-host coordination."""
+    idxs = np.asarray(batch_idxs)
+    actual = len(idxs)
+    mask = np.ones(batch_size, dtype=np.float32)
     if actual < batch_size:
-        pad = batch_size - actual
+        idxs = np.concatenate(
+            [idxs, np.repeat(idxs[:1], batch_size - actual)], axis=0)
+        mask[actual:] = 0.0
+    return idxs, mask
+
+
+def gather_batch(dataset: Dataset, batch_idxs: np.ndarray,
+                 batch_size: int,
+                 local: Optional[slice] = None) -> Dict[str, np.ndarray]:
+    """Gather one fixed-shape batch: uint8 images + labels + pool indices +
+    validity mask (0.0 on padding rows).
+
+    ``local`` restricts the EXPENSIVE work (image gather/decode) to the
+    given row range of the global batch — the per-host slice of a
+    multi-host mesh (parallel/mesh.py process_local_rows, the reference's
+    DistributedSampler rank slicing strategy.py:312-314).  The default
+    gathers every row."""
+    idxs, mask = padded_batch_layout(batch_idxs, batch_size)
+    if local is not None:
+        idxs, mask = idxs[local], mask[local]
+    # Real rows are a prefix (the global mask is monotone, so any slice of
+    # it is too); pad rows all repeat one index — decode it once.  For
+    # disk datasets the decode is deterministic per (seed, epoch, index),
+    # so the repeat is identical to re-gathering.
+    n_real = int(mask.sum())
+    images = dataset.gather(idxs[:n_real])
+    if n_real < len(idxs):
+        pad_img = dataset.gather(idxs[n_real:n_real + 1])
         images = np.concatenate(
-            [images, np.repeat(images[:1], pad, axis=0)], axis=0)
-        labels = np.concatenate([labels, np.repeat(labels[:1], pad)], axis=0)
-        batch_idxs = np.concatenate(
-            [batch_idxs, np.repeat(batch_idxs[:1], pad)], axis=0)
-        mask = np.concatenate([mask, np.zeros(pad, dtype=np.float32)], axis=0)
+            [images, np.repeat(pad_img, len(idxs) - n_real, axis=0)], axis=0)
+    labels = dataset.targets[idxs]
     return {"image": images, "label": labels.astype(np.int32),
-            "index": np.asarray(batch_idxs, dtype=np.int32), "mask": mask}
+            "index": np.asarray(idxs, dtype=np.int32), "mask": mask}
 
 
 def iterate_batches(
@@ -67,6 +91,7 @@ def iterate_batches(
     drop_last: bool = False,
     prefetch: int = 2,
     num_threads: int = 0,
+    local: Optional[slice] = None,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Yield fixed-shape host batches; with ``num_threads > 0``, N worker
     threads gather/decode batches concurrently and results are reassembled
@@ -78,7 +103,7 @@ def iterate_batches(
                                 drop_last=drop_last)
     if num_threads <= 0:
         for b in batches:
-            yield gather_batch(dataset, b, batch_size)
+            yield gather_batch(dataset, b, batch_size, local=local)
         return
 
     from collections import deque
@@ -92,13 +117,13 @@ def iterate_batches(
         max_inflight = num_threads + max(1, prefetch)
         for b in itertools.islice(it, max_inflight):
             pending.append(executor.submit(gather_batch, dataset, b,
-                                           batch_size))
+                                           batch_size, local=local))
         while pending:
             batch = pending.popleft().result()  # ordered; errors propagate
             nxt = next(it, None)
             if nxt is not None:
                 pending.append(executor.submit(gather_batch, dataset, nxt,
-                                               batch_size))
+                                               batch_size, local=local))
             yield batch
     finally:
         executor.shutdown(wait=False, cancel_futures=True)

@@ -79,6 +79,12 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--run_seed", type=int, default=0)
     p.add_argument("--num_devices", type=int, default=-1,
                    help="-1 = all local devices")
+    # Multi-host: jax.distributed over DCN (the reference is single-node
+    # only, strategy.py:288; these flags are the pod-scale replacement).
+    p.add_argument("--coordinator_address", type=str, default=None,
+                   help="host:port of process 0 (TPU pods auto-discover)")
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
     return p
 
 
@@ -120,14 +126,19 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
             lr_discriminator=args.lr_discriminator),
         run_seed=args.run_seed,
         num_devices=args.num_devices,
+        coordinator_address=args.coordinator_address,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
     )
 
 
 def main(argv: Optional[List[str]] = None):
     from .driver import run_experiment
     args = get_parser().parse_args(argv)
-    cfg = args_to_config(args)
-    return run_experiment(cfg)
+    # run_experiment performs the jax.distributed rendezvous itself (a
+    # no-op without the multi-host config fields), so programmatic callers
+    # get the same behavior as the CLI.
+    return run_experiment(args_to_config(args))
 
 
 if __name__ == "__main__":

@@ -24,6 +24,7 @@ import uuid
 from datetime import date
 from typing import Optional, Tuple
 
+import jax
 import numpy as np
 
 from ..config import ExperimentConfig, TrainConfig, config_to_dict
@@ -122,11 +123,27 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     with per-phase wall-clock timers (the reference prints them,
     main_al.py:160-178; here they also land in the metrics sink).
     """
+    # Multi-host rendezvous first — nothing above this may touch a JAX
+    # backend.  A no-op unless the config carries the multi-host fields.
+    mesh_lib.initialize_distributed(cfg.coordinator_address,
+                                    cfg.num_processes, cfg.process_id)
+
     if cfg.exp_hash is None:
         cfg.exp_hash = uuid.uuid4().hex[:9]
+        if jax.process_count() > 1:
+            # Every process must agree on the hash — it names the shared
+            # checkpoint/resume directories that non-coordinators read.
+            from jax.experimental import multihost_utils
+            agreed = multihost_utils.broadcast_one_to_all(
+                np.uint64(int(cfg.exp_hash, 16)))
+            cfg.exp_hash = f"{int(agreed):09x}"
 
     today = date.today()
     log_filename = (f"{cfg.exp_hash}_{today.month:02d}{today.day:02d}.log")
+    if jax.process_count() > 1:
+        # Per-process log files, like the reference's per-rank logging.
+        log_filename = log_filename.replace(
+            ".log", f"_p{jax.process_index()}.log")
     logger = setup_logging(cfg.log_dir, log_filename)
 
     resuming = cfg.resume_training and resume_lib.has_saved_experiment(cfg)
@@ -140,8 +157,9 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     if sink is None:
         key = (resume_lib.saved_experiment_key(cfg) if resuming
                else cfg.exp_hash)
-        sink = make_sink(cfg.enable_metrics, cfg.log_dir,
-                         experiment_key=key)
+        # Metrics/assets are run-level side effects: process 0 only.
+        sink = make_sink(cfg.enable_metrics and mesh_lib.is_coordinator(),
+                         cfg.log_dir, experiment_key=key)
     strategy = build_experiment(cfg, sink=sink, data=data, mesh=mesh,
                                 train_cfg=train_cfg, model=model,
                                 skip_init_pool=resuming)
@@ -185,7 +203,8 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
             with phase_timer("test_time", rd, sink, logger):
                 strategy.test()
 
-            resume_lib.save_experiment(strategy, cfg)
+            if mesh_lib.is_coordinator():
+                resume_lib.save_experiment(strategy, cfg)
             cfg.resume_training = True  # crash after this resumes (main_al.py:181)
             if len(strategy.available_query_idxs(shuffle=False)) == 0:
                 logger.info("Finished querying all Images!")

@@ -48,7 +48,12 @@ def save_experiment(strategy, cfg: ExperimentConfig) -> str:
     os.makedirs(directory, exist_ok=True)
     arrays = strategy.pool.to_arrays()
     arrays["init_key"] = np.asarray(strategy._init_key)
-    np.savez(os.path.join(directory, STATE_FILE), **arrays)
+    # Atomic writes (tmp + rename), meta LAST: has_saved_experiment checks
+    # both files, so a crash mid-save can never leave a round-N state file
+    # paired with a stale or truncated meta.
+    state_path = os.path.join(directory, STATE_FILE)
+    np.savez(state_path + ".tmp.npz", **arrays)
+    os.replace(state_path + ".tmp.npz", state_path)
     meta = {
         "round": int(strategy.round),
         "rng_state": strategy.rng.bit_generator.state,
@@ -56,8 +61,10 @@ def save_experiment(strategy, cfg: ExperimentConfig) -> str:
         "experiment_key": getattr(strategy.sink, "experiment_key", None),
         "best_epoch": int(strategy.best_epoch),
     }
-    with open(os.path.join(directory, META_FILE), "w") as fh:
+    meta_path = os.path.join(directory, META_FILE)
+    with open(meta_path + ".tmp", "w") as fh:
         json.dump(meta, fh, indent=2)
+    os.replace(meta_path + ".tmp", meta_path)
     get_logger().info(f"Saved experiment state for round {strategy.round} "
                       f"to {directory}")
     return directory

@@ -34,11 +34,62 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
 
     The TPU equivalent of the reference's NCCL rendezvous
     (strategy.py:288-289,315) — but done once per run, not once per round.
+    Must run before any JAX backend initializes.  On a TPU pod slice pass
+    just ``num_processes`` (the host count) and JAX auto-discovers the
+    coordinator and process id; CPU/GPU clusters pass all three.  The CLI
+    exposes --coordinator_address / --num_processes / --process_id.
+    With no arguments at all this is a no-op (single-process run).
     """
-    if num_processes is not None and num_processes > 1:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
+    if num_processes is None and coordinator_address is None:
+        return
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns run-level side effects (checkpoint
+    writes, metric sinks, audit files) — the reference's rank-0 guard
+    (strategy.py:425-430)."""
+    return jax.process_index() == 0
+
+
+def is_multiprocess(mesh: Mesh) -> bool:
+    """True when ``mesh`` spans devices of more than one process."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def process_local_rows(mesh: Mesh, batch_size: int) -> slice:
+    """The contiguous row range of a ``[batch_size, ...]`` batch (sharded
+    over the data axis) owned by THIS process's devices.
+
+    This is the per-host analogue of the reference's DistributedSampler
+    rank slicing (strategy.py:312-314): each host feeds only its own rows,
+    so a pod never decodes the full global batch per host.  Row ownership
+    is read off the sharding itself, so it stays correct for any device
+    order.  Single-process meshes own everything: slice(0, batch_size).
+    """
+    idx_map = batch_sharding(mesh).addressable_devices_indices_map(
+        (batch_size,))
+    if not idx_map:
+        raise AssertionError(
+            "this process owns no devices in the mesh — every process "
+            "must contribute all its local devices (see make_mesh)")
+    spans = []
+    for idx in idx_map.values():
+        s = idx[0]
+        spans.append((s.start or 0,
+                      batch_size if s.stop is None else s.stop))
+    lo = min(s for s, _ in spans)
+    hi = max(e for _, e in spans)
+    if sum(e - s for s, e in spans) != hi - lo:
+        raise AssertionError(
+            f"process-local rows are not contiguous: {sorted(spans)}; "
+            "the data axis must map each process to one contiguous block")
+    return slice(lo, hi)
 
 
 def make_mesh(num_devices: int = -1,
@@ -50,6 +101,13 @@ def make_mesh(num_devices: int = -1,
         devices = jax.devices()
     if num_devices == -1:
         num_devices = len(devices)
+    if jax.process_count() > 1 and num_devices != len(devices):
+        # Trimming would drop some processes' devices entirely — those
+        # processes would own no rows of any batch and every collective
+        # would deadlock or diverge.  Shrink the world, not the mesh.
+        raise ValueError(
+            f"num_devices={num_devices} would trim a {len(devices)}-device "
+            "multi-host mesh; use fewer processes instead")
     devices = np.asarray(devices[:num_devices])
     return Mesh(devices, (DATA_AXIS,))
 
@@ -66,11 +124,54 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh) -> Dict[str, Any]:
     """Host batch -> device arrays with the batch axis sharded over the
     mesh.  This is the host->device boundary (the reference's pinned-memory
-    H2D copies, strategy.py:264,328)."""
+    H2D copies, strategy.py:264,328).
+
+    Single-process: ``batch`` holds the full global batch.  Multi-process:
+    every process passes ONLY its ``process_local_rows`` slice and the
+    global array is assembled across hosts — the data-parallel contract of
+    the reference's per-rank DataLoader (strategy.py:325-328) without any
+    cross-host copy of example data.
+    """
     sharding = batch_sharding(mesh)
-    return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+    if not is_multiprocess(mesh):
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+    n_local = mesh.local_mesh.devices.size
+    scale = mesh.devices.size // n_local
+    return {
+        k: jax.make_array_from_process_local_data(
+            sharding, np.asarray(v), (v.shape[0] * scale, *v.shape[1:]))
+        for k, v in batch.items()
+    }
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Copy a host pytree to every device (every process passes the same
+    values — the usual multi-controller contract)."""
     sharding = replicated_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+    if not is_multiprocess(mesh):
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sharding,
+                                            lambda idx: x[idx])
+
+    return jax.tree.map(put, tree)
+
+
+def fetch(tree: Any, mesh: Optional[Mesh] = None) -> Any:
+    """Device pytree -> host numpy, working for batch-sharded outputs on
+    multi-host meshes too (each process sees the full global array — the
+    reference's dist.all_gather of eval/score results, evaluation.py:69-98).
+    Fully-replicated outputs (losses, metric counts) are fetched directly.
+    """
+    if mesh is None or not is_multiprocess(mesh):
+        return jax.tree.map(np.asarray, tree)
+    from jax.experimental import multihost_utils
+
+    def one(x):
+        if getattr(x, "is_fully_replicated", True):
+            return np.asarray(x)
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+    return jax.tree.map(one, tree)

@@ -29,7 +29,8 @@ import numpy as np
 from ..data.augment import apply_view
 from ..data.core import Dataset, ViewSpec
 from ..parallel import mesh as mesh_lib
-from ..data.pipeline import iterate_batches
+from ..data.pipeline import (batch_index_lists, iterate_batches,
+                             padded_batch_layout)
 
 
 def make_prob_stats_step(model, view: ViewSpec) -> Callable:
@@ -194,18 +195,37 @@ def collect_pool(
     if n == 0:
         raise ValueError("collect_pool called with empty idxs; guard the "
                          "exhausted-pool case in the sampler")
+    # On a multi-host mesh each process gathers/decodes only its own rows
+    # of every global batch; score rows come back in GLOBAL batch order
+    # (mesh_lib.fetch all-gathers sharded outputs), so the global row
+    # layout is recomputed here both to check alignment and to map scores
+    # back to pool indices.
+    local = mesh_lib.process_local_rows(mesh, batch_size)
+    multi = mesh_lib.is_multiprocess(mesh)
+    layouts = [padded_batch_layout(b, batch_size)[0]
+               for b in batch_index_lists(idxs, batch_size)]
     chunks: Dict[str, list] = {}
-    row_idxs: list = []
-    for batch in iterate_batches(dataset, idxs, batch_size,
-                                 num_threads=num_workers, prefetch=prefetch):
-        row_idxs.append(batch["index"].copy())
+    for i, batch in enumerate(iterate_batches(
+            dataset, idxs, batch_size, num_threads=num_workers,
+            prefetch=prefetch, local=local)):
+        # The threaded prefetcher must deliver batches in order, and this
+        # process's rows must be exactly its slice of the global layout —
+        # the class of bug the reference has at confidence_sampler.py:41
+        # (scores sorted by a scrambled index) cannot pass silently here.
+        if not np.array_equal(batch["index"],
+                              layouts[i][local].astype(np.int32)):
+            raise AssertionError(
+                "scoring rows misaligned with the global batch layout")
         out = step_fn(variables, mesh_lib.shard_batch(batch, mesh))
         if keys is not None:
             out = {k: out[k] for k in keys}
         for k, v in out.items():
-            chunks.setdefault(k, []).append(np.asarray(v))
-    got_idxs = np.concatenate(row_idxs, axis=0)[:n]
-    if not np.array_equal(got_idxs, idxs):
-        raise AssertionError(
-            "scoring rows misaligned with requested pool indices")
+            # Multi-host: keep device arrays and cross-host-gather ONCE
+            # after the loop — a per-batch gather would serialize a DCN
+            # round-trip into every step of the acquisition hot path.
+            chunks.setdefault(k, []).append(v if multi else np.asarray(v))
+    if multi:
+        return {k: np.asarray(mesh_lib.fetch(jnp.concatenate(v, axis=0),
+                                             mesh))[:n]
+                for k, v in chunks.items()}
     return {k: np.concatenate(v, axis=0)[:n] for k, v in chunks.items()}

@@ -227,7 +227,8 @@ class VAALSampler(Strategy):
                 if len(unlabeled) == 0:  # pool exhausted: recycle labeled
                     unlabeled = labeled
                 unlabeled_iter_holder["iter"] = iterate_batches(
-                    self.train_set, unlabeled, bs)
+                    self.train_set, unlabeled, bs,
+                    local=mesh_lib.process_local_rows(self.mesh, bs))
                 batch = next(unlabeled_iter_holder["iter"])
             return batch
 

@@ -23,10 +23,15 @@ from flax import serialization
 
 
 def save_variables(path: str, variables: Dict[str, Any]) -> None:
+    """Atomic write (tmp + rename): a reader never sees a half-written
+    checkpoint — mid-round resume (experiment/resume.py) and non-writer
+    pod processes both read these files."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     host_vars = jax.tree.map(np.asarray, variables)
-    with open(path, "wb") as fh:
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as fh:
         fh.write(serialization.msgpack_serialize(host_vars))
+    os.replace(tmp, path)
 
 
 def load_variables(path: str, like: Dict[str, Any] = None) -> Dict[str, Any]:

@@ -294,11 +294,13 @@ class Trainer:
         bs = self.padded_batch_size(self.cfg.loader_te.batch_size)
         variables = state.variables
 
+        local = mesh_lib.process_local_rows(self.mesh, bs)
+
         def counts():
             for batch in iterate_batches(
                     dataset, idxs, bs,
                     num_threads=self.cfg.loader_te.num_workers,
-                    prefetch=self.cfg.loader_te.prefetch):
+                    prefetch=self.cfg.loader_te.prefetch, local=local):
                 yield eval_step(variables,
                                 mesh_lib.shard_batch(batch, self.mesh))
 
@@ -387,7 +389,8 @@ class Trainer:
                 for batch in iterate_batches(
                         train_set, labeled_idxs, bs, shuffle=True, rng=rng,
                         num_threads=self.cfg.loader_tr.num_workers,
-                        prefetch=self.cfg.loader_tr.prefetch):
+                        prefetch=self.cfg.loader_tr.prefetch,
+                        local=mesh_lib.process_local_rows(self.mesh, bs)):
                     key, sub = jax.random.split(key)
                     sharded = mesh_lib.shard_batch(batch, self.mesh)
                     state, loss = self._train_step(
@@ -424,7 +427,10 @@ class Trainer:
                     best_perf, best_epoch, es_count = eval_acc, epoch, 0
                     best_variables = jax.tree.map(np.asarray,
                                                   state.variables)
-                    if weight_paths:
+                    # Rank-0-style write guard (strategy.py:425-430); on a
+                    # pod the ckpt_path must be a shared filesystem so
+                    # every process can read it back.
+                    if weight_paths and mesh_lib.is_coordinator():
                         ckpt_lib.save_variables(weight_paths["best_ckpt"],
                                                 best_variables)
                 else:
@@ -433,7 +439,8 @@ class Trainer:
                 # (strategy.py:440) and never consumes it; a full-variable
                 # host transfer per epoch would dominate small-model epochs
                 # on TPU, so write it periodically + on exit instead.
-                if weight_paths and epoch % self.current_ckpt_every == 0:
+                if (weight_paths and mesh_lib.is_coordinator()
+                        and epoch % self.current_ckpt_every == 0):
                     ckpt_lib.save_variables(weight_paths["current_ckpt"],
                                             jax.tree.map(np.asarray,
                                                          state.variables))
@@ -445,13 +452,18 @@ class Trainer:
         if best_variables is None:
             best_epoch = epochs_run
             best_variables = jax.tree.map(np.asarray, state.variables)
-            if weight_paths:
+            if weight_paths and mesh_lib.is_coordinator():
                 ckpt_lib.save_variables(weight_paths["best_ckpt"],
                                         best_variables)
-        if weight_paths:
+        if weight_paths and mesh_lib.is_coordinator():
             ckpt_lib.save_variables(weight_paths["current_ckpt"],
                                     jax.tree.map(np.asarray,
                                                  state.variables))
+        if mesh_lib.is_multiprocess(self.mesh):
+            # Non-writer processes must not race ahead to read best_ckpt
+            # (strategy.load_best_ckpt) before process 0 finishes writing.
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("fit_ckpts_written")
         self.logger.info(
             f"Sanity Check: Best ckpt occurs on epoch {best_epoch}")
         return FitResult(state=state, best_epoch=best_epoch,

@@ -1,0 +1,88 @@
+"""Worker for the 2-process CPU multi-host smoke test.
+
+Each process owns 2 virtual CPU devices; together they form one 4-device
+global mesh, the CPU stand-in for a 2-host TPU pod slice over DCN.  The
+worker runs a real multi-epoch ``Trainer.fit`` (per-process batch slicing,
+cross-process gradient reduction, global-batch BN-free tiny model,
+sharded validation) plus a ``collect_pool`` scoring pass with the
+cross-host result gather, then writes one JSON summary.
+
+Manual smoke recipe (also driven by tests/test_multihost.py):
+
+    PORT=$(python -c "import socket; s=socket.socket(); \
+           s.bind(('127.0.0.1', 0)); print(s.getsockname()[1])")
+    for P in 0 1; do
+      PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+      python tests/multihost_worker.py 127.0.0.1:$PORT 2 $P /tmp/mh_$P.json &
+    done; wait; cat /tmp/mh_*.json
+
+The same flags reach the real CLI as --coordinator_address /
+--num_processes / --process_id (experiment/cli.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, nprocs, pid, out_path = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nprocs, process_id=pid)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    import numpy as np
+
+    from active_learning_tpu.data.synthetic import get_data_synthetic
+    from active_learning_tpu.parallel import mesh as mesh_lib
+    from active_learning_tpu.strategies import scoring
+    from active_learning_tpu.train.trainer import Trainer
+    from helpers import TinyClassifier, tiny_train_config
+
+    mesh = mesh_lib.make_mesh()
+    bs = 8
+    local = mesh_lib.process_local_rows(mesh, bs)
+
+    train_set, _, al_set = get_data_synthetic(
+        n_train=64, n_test=16, num_classes=4, image_size=8, seed=3)
+    model = TinyClassifier()
+    trainer = Trainer(model, tiny_train_config(batch_size=bs), mesh,
+                      num_classes=4)
+    state = trainer.init_state(jax.random.PRNGKey(0),
+                               train_set.gather(np.arange(2)))
+    result = trainer.fit(state, train_set, np.arange(32), al_set,
+                         np.arange(32, 48), n_epoch=2, es_patience=2,
+                         rng=np.random.default_rng(0))
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree.map(np.asarray, result.state.params))
+    flat = np.concatenate([p.ravel() for p in leaves])
+
+    step = scoring.make_prob_stats_step(model, al_set.view)
+    scores = scoring.collect_pool(al_set, np.arange(48, 64), bs, step,
+                                  result.state.variables, mesh)
+
+    out = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "n_devices_global": int(mesh.devices.size),
+        "local_rows": [local.start, local.stop],
+        "best_perf": float(result.best_perf),
+        "param_sum": float(flat.sum()),
+        "margin": np.asarray(scores["margin"], np.float64).tolist(),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(out, fh)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
