@@ -46,12 +46,82 @@ def weight_paths(ckpt_root: str, exp_name: str, exp_hash: str,
                  round_idx: int) -> Dict[str, str]:
     """best/current/previous checkpoint paths for a round
     (strategy.py:165-173; ``previous_ckpt`` kept for parity though the
-    reference never consumes it)."""
+    reference never consumes it).  ``fit_state`` is this framework's
+    addition: the mid-round resume state (the reference writes rd_{n}.pth
+    every epoch but never reads it back — strategy.py:440,
+    resume_training.py:8-52 resume at round granularity only)."""
     ckpt_dir = os.path.join(ckpt_root, f"{exp_name}_{exp_hash}")
     os.makedirs(ckpt_dir, exist_ok=True)
     return {
         "best_ckpt": os.path.join(ckpt_dir, f"best_rd_{round_idx}.msgpack"),
         "previous_ckpt": os.path.join(ckpt_dir, f"rd_{round_idx - 1}.msgpack"),
         "current_ckpt": os.path.join(ckpt_dir, f"rd_{round_idx}.msgpack"),
+        "fit_state": os.path.join(ckpt_dir, f"fit_state_rd_{round_idx}"),
         "dir": ckpt_dir,
     }
+
+
+# -- mid-round fit state ----------------------------------------------------
+#
+# Everything needed to continue an interrupted Trainer.fit from the last
+# completed epoch, bit-for-bit: model variables, optimizer state, the
+# early-stopping bookkeeping, the jax PRNG-key chain, and the numpy
+# Generator state that drives batch shuffling.  Two files per round:
+# {path}.msgpack (the big trees) + {path}.json (counters + rng state),
+# written atomically with the json LAST so a crash mid-save is never
+# mistaken for a complete state.
+
+import json as _json
+
+from typing import Optional
+
+
+def save_fit_state(path: str, *, variables: Dict[str, Any], opt_state: Any,
+                   step: Any, epoch: int, round_idx: int, best_perf: float,
+                   best_epoch: int, es_count: int, key: Any,
+                   rng: np.random.Generator) -> None:
+    trees = {
+        "variables": serialization.to_state_dict(
+            jax.tree.map(np.asarray, variables)),
+        "opt_state": serialization.to_state_dict(
+            jax.tree.map(np.asarray, opt_state)),
+    }
+    with open(path + ".msgpack.tmp", "wb") as fh:
+        fh.write(serialization.msgpack_serialize(trees))
+    os.replace(path + ".msgpack.tmp", path + ".msgpack")
+    meta = {
+        "epoch": int(epoch),
+        "round_idx": int(round_idx),
+        "step": int(np.asarray(step)),
+        "best_perf": float(best_perf),
+        "best_epoch": int(best_epoch),
+        "es_count": int(es_count),
+        "key": np.asarray(key).tolist(),
+        "rng_state": rng.bit_generator.state,
+    }
+    with open(path + ".json.tmp", "w") as fh:
+        _json.dump(meta, fh)
+    os.replace(path + ".json.tmp", path + ".json")
+
+
+def load_fit_state(path: str, round_idx: int) -> Optional[Dict[str, Any]]:
+    """Return the saved mid-round state, or None when there is nothing to
+    resume (no file, or a state belonging to a different round)."""
+    if not (os.path.exists(path + ".msgpack")
+            and os.path.exists(path + ".json")):
+        return None
+    with open(path + ".json") as fh:
+        meta = _json.load(fh)
+    if meta.get("round_idx") != int(round_idx):
+        return None
+    with open(path + ".msgpack", "rb") as fh:
+        trees = serialization.msgpack_restore(fh.read())
+    return {**meta, **trees}
+
+
+def delete_fit_state(path: str) -> None:
+    for suffix in (".msgpack", ".json"):
+        try:
+            os.remove(path + suffix)
+        except FileNotFoundError:
+            pass

@@ -23,13 +23,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from flax import struct
+from flax import serialization, struct
 
 from ..config import TrainConfig
 from ..data.augment import apply_view
@@ -367,8 +368,48 @@ class Trainer:
         history: List[Dict[str, float]] = []
         key = jax.random.PRNGKey(int(rng.integers(0, 2 ** 31 - 1)))
 
+        # Mid-round resume: if a fit-state checkpoint for THIS round exists
+        # (written periodically below, deleted when the round completes), a
+        # crashed/preempted fit continues from its last completed epoch
+        # bit-for-bit instead of restarting the round — epoch-granularity
+        # recovery the reference lacks (its rd_{n}.pth is written every
+        # epoch and never read back, strategy.py:440).  VAAL's co-trained
+        # VAE/discriminator state is not covered: with a batch_hook the
+        # resumed fit restarts from epoch 1.
+        start_epoch = 1
+        if weight_paths and batch_hook is None:
+            saved = ckpt_lib.load_fit_state(weight_paths["fit_state"],
+                                            round_idx)
+            if saved is not None:
+                host = jax.tree.map(np.asarray, state.variables)
+                variables = serialization.from_state_dict(
+                    host, saved["variables"])
+                opt_state = serialization.from_state_dict(
+                    jax.tree.map(np.asarray, state.opt_state),
+                    saved["opt_state"])
+                state = TrainState(
+                    params=mesh_lib.replicate(variables["params"],
+                                              self.mesh),
+                    batch_stats=mesh_lib.replicate(
+                        variables.get("batch_stats", {}), self.mesh),
+                    opt_state=mesh_lib.replicate(opt_state, self.mesh),
+                    step=jnp.asarray(saved["step"], jnp.int32))
+                best_perf = float(saved["best_perf"])
+                best_epoch = int(saved["best_epoch"])
+                es_count = int(saved["es_count"])
+                key = jnp.asarray(np.asarray(saved["key"], dtype=np.uint32))
+                rng.bit_generator.state = saved["rng_state"]
+                start_epoch = int(saved["epoch"]) + 1
+                if best_epoch > 0 and os.path.exists(
+                        weight_paths["best_ckpt"]):
+                    best_variables = ckpt_lib.load_variables(
+                        weight_paths["best_ckpt"], like=host)
+                self.logger.info(
+                    f"Resuming round {round_idx} training from epoch "
+                    f"{start_epoch} (mid-round fit state)")
+
         epochs_run = 0
-        for epoch in range(1, n_epoch + 1):
+        for epoch in range(start_epoch, n_epoch + 1):
             epochs_run = epoch
             if hasattr(train_set, "set_epoch"):
                 # Advance disk datasets' per-(seed, epoch, index) crop RNG
@@ -445,6 +486,16 @@ class Trainer:
                                             jax.tree.map(np.asarray,
                                                          state.variables))
             history.append(record)
+            if (weight_paths and batch_hook is None
+                    and mesh_lib.is_coordinator()
+                    and epoch % self.current_ckpt_every == 0
+                    and epoch < n_epoch):
+                ckpt_lib.save_fit_state(
+                    weight_paths["fit_state"], variables=state.variables,
+                    opt_state=state.opt_state, step=state.step, epoch=epoch,
+                    round_idx=round_idx, best_perf=best_perf,
+                    best_epoch=best_epoch, es_count=es_count, key=key,
+                    rng=rng)
             if use_es and es_count > es_patience:
                 self.logger.info("Early stopping criterion reached. ")
                 break
@@ -459,6 +510,9 @@ class Trainer:
             ckpt_lib.save_variables(weight_paths["current_ckpt"],
                                     jax.tree.map(np.asarray,
                                                  state.variables))
+            # The round completed: a later restart must re-run it from
+            # scratch (the experiment-level resume owns cross-round state).
+            ckpt_lib.delete_fit_state(weight_paths["fit_state"])
         if mesh_lib.is_multiprocess(self.mesh):
             # Non-writer processes must not race ahead to read best_ckpt
             # (strategy.load_best_ckpt) before process 0 finishes writing.

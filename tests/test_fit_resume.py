@@ -1,0 +1,104 @@
+"""Epoch-level mid-round resume.
+
+The reference writes rd_{n}.pth every epoch but its resume path never
+reads it (strategy.py:440, resume_training.py:8-52) — a mid-round crash
+loses the whole round.  Here Trainer.fit periodically writes a full
+fit-state checkpoint (variables + optimizer state + early-stop counters +
+both RNG streams) and automatically continues from the last completed
+saved epoch, so a killed fit resumes bit-for-bit instead of restarting.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from active_learning_tpu.data.synthetic import get_data_synthetic
+from active_learning_tpu.parallel import mesh as mesh_lib
+from active_learning_tpu.train import checkpoint as ckpt_lib
+from active_learning_tpu.train.trainer import Trainer
+
+from helpers import tiny_train_config
+from test_trainer_parallel import BNClassifier  # BN: batch_stats restore
+                                                # is exercised for real
+
+N_EPOCH = 6
+CADENCE = 2  # fit-state written after epochs 2 and 4
+
+
+class Boom(Exception):
+    pass
+
+
+def _flat(tree):
+    leaves = [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(tree)]
+    return np.concatenate(leaves) if leaves else np.zeros(0)
+
+
+@pytest.mark.parametrize("device_resident", [False, True])
+class TestMidRoundResume:
+    def _fit(self, tmp_path, tag, device_resident, metric_cb=None):
+        """One fit run from identical initial conditions."""
+        import dataclasses
+        train_set, _, al_set = get_data_synthetic(
+            n_train=64, n_test=16, num_classes=4, image_size=8, seed=11)
+        cfg = dataclasses.replace(tiny_train_config(batch_size=16),
+                                  device_resident=device_resident)
+        mesh = mesh_lib.make_mesh()
+        trainer = Trainer(BNClassifier(), cfg, mesh, num_classes=4,
+                          train_bn=True, current_ckpt_every=CADENCE)
+        state = trainer.init_state(jax.random.PRNGKey(0),
+                                   train_set.gather(np.arange(2)))
+        paths = ckpt_lib.weight_paths(str(tmp_path), "t", tag, round_idx=1)
+        result = trainer.fit(
+            state, train_set, np.arange(48), al_set, np.arange(48, 64),
+            n_epoch=N_EPOCH, es_patience=10,
+            rng=np.random.default_rng(7), round_idx=1, weight_paths=paths,
+            metric_cb=metric_cb)
+        return result, paths
+
+    def test_resume_matches_uninterrupted_run(self, tmp_path,
+                                              device_resident):
+        ref, ref_paths = self._fit(tmp_path / "a", "a", device_resident)
+        # A completed round must leave no fit state behind — a restart
+        # re-runs the round from scratch under the experiment-level resume.
+        assert ckpt_lib.load_fit_state(ref_paths["fit_state"], 1) is None
+
+        def boom(name, value, step):
+            if step == 5 and name.endswith("validation_accuracy"):
+                raise Boom()
+
+        with pytest.raises(Boom):
+            self._fit(tmp_path / "b", "b", device_resident, metric_cb=boom)
+        # The crash (mid-epoch-5) left the epoch-4 fit state on disk.
+        saved = ckpt_lib.load_fit_state(
+            str(tmp_path / "b" / "t_b" / "fit_state_rd_1"), 1)
+        assert saved is not None and saved["epoch"] == 4
+
+        resumed, res_paths = self._fit(tmp_path / "b", "b", device_resident)
+        # Continued from epoch 5, not from scratch.
+        assert resumed.history[0]["epoch"] == 5
+        assert resumed.epochs_run == ref.epochs_run
+        assert resumed.best_epoch == ref.best_epoch
+        assert resumed.best_perf == ref.best_perf
+        # Bit-for-bit identical trained state.
+        np.testing.assert_array_equal(_flat(resumed.state.params),
+                                      _flat(ref.state.params))
+        np.testing.assert_array_equal(_flat(resumed.state.batch_stats),
+                                      _flat(ref.state.batch_stats))
+        # And the resumed round also cleans up after itself.
+        assert ckpt_lib.load_fit_state(res_paths["fit_state"], 1) is None
+
+    def test_stale_state_from_other_round_is_ignored(self, tmp_path,
+                                                     device_resident):
+        _, paths = self._fit(tmp_path, "c", device_resident)
+        # Fabricate a leftover state tagged round 3 at the round-1 path:
+        # must be ignored, not resumed.
+        ckpt_lib.save_fit_state(
+            paths["fit_state"], variables={"params": {}}, opt_state={},
+            step=np.int32(0), epoch=4, round_idx=3, best_perf=0.0,
+            best_epoch=0, es_count=0, key=np.zeros(2, np.uint32),
+            rng=np.random.default_rng(0))
+        assert ckpt_lib.load_fit_state(paths["fit_state"], 1) is None
+        assert ckpt_lib.load_fit_state(paths["fit_state"], 3) is not None
