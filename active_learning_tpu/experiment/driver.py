@@ -165,6 +165,9 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
                                 skip_init_pool=resuming)
     if resuming:
         start_round = resume_lib.load_experiment(strategy, cfg)
+        # The first fit of a resumed run may consume a mid-round fit state
+        # (epoch-level recovery); non-resumed runs discard stale ones.
+        strategy.resume_next_fit = True
     else:
         start_round = 0
         sink.log_parameters(config_to_dict(cfg))

@@ -6,10 +6,32 @@ budget, pick the unlabeled point whose distance to the rarest-class
 centroid, normalized by its largest distance to any majority-class
 centroid, is smallest; otherwise pick uniformly at random.
 
-The embedding pass over the WHOLE al_set (:39-53) is mesh-parallel here and
-cached under ``freeze_feature`` (:34-36, 55-57).  The per-pick loop is host
-NumPy: each step is O(N * M) on a few-thousand-row slice and data-dependent
-on the previous pick, so there is nothing for the mesh to win.
+The reference runs the whole per-pick distance pass on host NumPy
+(:83-125): every selection is a fresh O(N_unlabeled x C x D) pass over the
+pool, so 10k picks over a 1.28M-image pool is hours of host time.  Here the
+pool embeddings and the eligibility mask live ON DEVICE, sharded over the
+mesh's data axis, for the whole query:
+
+  * one O(N) upload, deferred to the FIRST balancing pick — a query that
+    stays in the random branch throughout never touches the device;
+  * each balancing pick is ONE jitted SPMD call — masked distance pass +
+    global argmin across shards — whose host<->device traffic is O(C*D)
+    (the centroids) down and ONE scalar (the argmin) back, independent of
+    pool size;
+  * the host keeps incremental per-class counts and embedding sums
+    (O(D) per pick), because the sequential label-peeking update makes the
+    pick loop inherently serial.
+
+Precision, disclosed deliberately: the reference's loop mixes float32
+embeddings with float64 centroid math (np.zeros defaults, :96-118).  Here
+centroid SUMS accumulate in float64 on host, but centers are cast to
+float32 for the device pass, whose distances/matmul run in float32
+(matmul pinned to Precision.HIGHEST so the MXU doesn't drop to bfloat16).
+Two candidates whose true scores agree to ~1e-6 relative may therefore
+argmin differently than the float64 host loop — an immaterial tie-break
+for acquisition quality, traded for running the pass on the mesh at all.
+The oracle test (tests/test_clustering_balancing.py) pins THESE float32
+semantics.
 
 Reference quirks preserved deliberately:
   * the normalizer is the MAX distance to the majority centroids despite
@@ -23,9 +45,44 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
+from ..parallel import mesh as mesh_lib
 from .base import Strategy, register_strategy
+
+
+@jax.jit
+def _balancing_pick(emb, eligible, centers, maj_mask, rarest, rare_empty):
+    """One balancing selection, fully on device (balancing_sampler.py:83-125).
+
+    emb [N, D] and eligible [N] are sharded over the data axis; centers
+    [C, D] / maj_mask [C] / rarest / rare_empty are replicated scalars or
+    tiny arrays.  Returns the global pool index of the pick.
+    """
+    d_rare = ((emb - centers[rarest][None, :]) ** 2).sum(axis=1)
+    d_rare = jnp.where(rare_empty, jnp.ones_like(d_rare), d_rare)
+    # Distances to ALL centroids via the expanded form (one [N, C] matmul),
+    # then a masked max over the majority classes only — the static-shape
+    # equivalent of the reference's centers[maj] gather (:110-118).
+    # HIGHEST precision: at default precision the TPU MXU contracts in
+    # bfloat16, whose rounding error in a2 + b2 - 2ab is comparable to
+    # small true distances — a near-centroid norm could come out ~0 or
+    # negative and flip the argmin toward a majority centroid.
+    a2 = (emb ** 2).sum(axis=1, keepdims=True)
+    b2 = (centers ** 2).sum(axis=1)[None, :]
+    d_all = a2 + b2 - 2.0 * jnp.matmul(
+        emb, centers.T, precision=jax.lax.Precision.HIGHEST)
+    d_maj = jnp.where(maj_mask[None, :], d_all, -jnp.inf)
+    norm = jnp.max(d_maj, axis=1)  # the reference's max (:116)
+    score = jnp.where(eligible, d_rare / norm, jnp.inf)
+    return jnp.argmin(score)
+
+
+@jax.jit
+def _mark_taken(eligible, idx):
+    return eligible.at[idx].set(False)
 
 
 @register_strategy("BalancingSampler")
@@ -45,20 +102,58 @@ class BalancingSampler(Strategy):
             self._saved_embeddings = emb
         return emb
 
+    def _device_pool_state(self, embeddings: np.ndarray,
+                           eligible: np.ndarray):
+        """Upload the pool once: embeddings + eligibility mask, padded to
+        the mesh size and sharded over the data axis.  Padded rows are
+        ineligible so they can never win the argmin."""
+        mesh = self.mesh
+        n = embeddings.shape[0]
+        pad = (-n) % mesh.devices.size
+        emb = np.ascontiguousarray(
+            np.pad(embeddings.astype(np.float32), ((0, pad), (0, 0))))
+        elig = np.pad(eligible, (0, pad))
+        sharding = mesh_lib.batch_sharding(mesh)
+        if mesh_lib.is_multiprocess(mesh):
+            rows = mesh_lib.process_local_rows(mesh, n + pad)
+
+            def put(a):
+                return jax.make_array_from_process_local_data(
+                    sharding, np.ascontiguousarray(a[rows]), a.shape)
+
+            return put(emb), put(elig)
+        return (jax.device_put(emb, sharding),
+                jax.device_put(elig, sharding))
+
     def query(self, budget: int) -> Tuple[np.ndarray, int]:
         ys = self.al_set.targets[: len(self.al_set)]
         idxs_for_query = self.available_query_mask().copy()
-        idxs_labeled = self.already_labeled_mask().copy()
         budget = int(min(idxs_for_query.sum(), budget))
         if budget == 0:
             return np.zeros(0, dtype=np.int64), 0
         embeddings = self._all_embeddings()  # float32, like the reference
         n_classes = self.num_classes
 
+        # Deferred to the first balancing pick: random-only queries (the
+        # common case while the labeled set stays balanced) never pay the
+        # O(N*D) upload or the per-pick device round-trips.
+        emb_dev = eligible_dev = None
+
+        # Host-side class bookkeeping, updated incrementally per pick
+        # (the reference recomputes from the full labeled set each pick,
+        # balancing_sampler.py:96-104 — same value, O(C*D) instead of
+        # O(L*D) per step).
+        labeled = self.already_labeled_mask()
+        counts = np.bincount(ys[labeled], minlength=n_classes
+                             ).astype(np.int64)
+        # float64 accumulation, like the reference's np.zeros default
+        # (:96): a whole labeled set summed in float32 would lose the low
+        # bits that separate near-identical centroids.
+        sums = np.zeros((n_classes, embeddings.shape[1]), dtype=np.float64)
+        np.add.at(sums, ys[labeled], embeddings[labeled])
+
         selected = []
         for query_count in range(budget):
-            ys_labeled = ys[idxs_labeled]
-            counts = np.bincount(ys_labeled, minlength=n_classes)
             mean_count = counts.mean()
             maj = counts > mean_count
             minor = ~maj
@@ -67,33 +162,33 @@ class BalancingSampler(Strategy):
 
             remaining = budget - query_count
             if remaining <= minor.sum() * (avg_maj - avg_minor):
-                # Balancing pick (:83-125).
-                emb_labeled = embeddings[idxs_labeled]
-                centers = np.zeros((n_classes, embeddings.shape[1]))
-                np.add.at(centers, ys_labeled, emb_labeled)
-                denom = counts[:, None] + 1e-5
-                centers = centers / denom
+                # Balancing pick: one sharded distance pass + argmin on
+                # device; only the centroids go down and one index comes
+                # back.
+                if emb_dev is None:
+                    emb_dev, eligible_dev = self._device_pool_state(
+                        embeddings, idxs_for_query)
+                centers = (sums / (counts[:, None] + 1e-5)
+                           ).astype(np.float32)
                 rarest = int(np.argmin(counts))
-                emb_unlabeled = embeddings[idxs_for_query]
-
-                d_rare = ((emb_unlabeled - centers[rarest]) ** 2).sum(1)
-                if counts[rarest] == 0:
-                    d_rare = np.ones_like(d_rare)
-                centers_maj = centers[maj]
-                a2 = (emb_unlabeled ** 2).sum(1, keepdims=True)
-                b2 = (centers_maj ** 2).sum(1, keepdims=True)
-                d_maj = a2 + b2.T - 2.0 * emb_unlabeled @ centers_maj.T
-                norm = d_maj.max(axis=1)  # the reference's max (:116)
-                score = d_rare / norm
-                local = int(np.argmin(score))
-                query_idx = int(np.flatnonzero(idxs_for_query)[local])
+                small = mesh_lib.replicate(
+                    (centers, maj, np.int32(rarest),
+                     np.bool_(counts[rarest] == 0)), self.mesh)
+                query_idx = int(_balancing_pick(emb_dev, eligible_dev,
+                                                *small))
             else:
                 # Balanced enough: random pick (:126-128).
                 query_idx = int(self.rng.choice(
                     np.flatnonzero(idxs_for_query)))
 
             idxs_for_query[query_idx] = False
-            idxs_labeled[query_idx] = True
+            if eligible_dev is not None:
+                eligible_dev = _mark_taken(
+                    eligible_dev,
+                    mesh_lib.replicate(np.int32(query_idx), self.mesh))
+            c = int(ys[query_idx])
+            counts[c] += 1
+            sums[c] += embeddings[query_idx]
             selected.append(query_idx)
 
         self.logger.info(f"Number of queried images: {budget}")

@@ -80,6 +80,11 @@ class Strategy:
         self.mesh = trainer.mesh
         self.state: Optional[TrainState] = None
         self.best_epoch: int = 0
+        # True only for the first train() after a genuine experiment
+        # resume (the driver sets it): that is the one fit allowed to
+        # consume a mid-round fit state from disk; trainer.fit discards
+        # stale states otherwise.
+        self.resume_next_fit: bool = False
         self._score_steps: Dict[str, Callable] = {}
         # Per-experiment init key; split once per re-init so every round's
         # random re-initialization is fresh but reproducible.
@@ -194,7 +199,9 @@ class Strategy:
             round_idx=self.round,
             weight_paths=self.weight_paths(),
             metric_cb=metric_cb,
+            resume_fit_state=self.resume_next_fit,
         )
+        self.resume_next_fit = False
         self.state = result.state
         self.best_epoch = result.best_epoch
         self.logger.info(f"Finished training on round {self.round}")

@@ -14,8 +14,9 @@ Checkpoint paths follow the reference's layout
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
@@ -67,14 +68,12 @@ def weight_paths(ckpt_root: str, exp_name: str, exp_hash: str,
 # completed epoch, bit-for-bit: model variables, optimizer state, the
 # early-stopping bookkeeping, the jax PRNG-key chain, and the numpy
 # Generator state that drives batch shuffling.  Two files per round:
-# {path}.msgpack (the big trees) + {path}.json (counters + rng state),
-# written atomically with the json LAST so a crash mid-save is never
-# mistaken for a complete state.
-
-import json as _json
-
-from typing import Optional
-
+# {path}.msgpack (the big trees) + {path}.json (counters + rng state).
+# Each file is written atomically, and both carry the same (round, epoch)
+# stamp, cross-checked at load: a crash part-way through the pair — before
+# the json exists, or between the two os.replace calls when OVERWRITING an
+# earlier save — can never pair one epoch's weights with another epoch's
+# counters; the torn state reads as nothing-to-resume instead.
 
 def save_fit_state(path: str, *, variables: Dict[str, Any], opt_state: Any,
                    step: Any, epoch: int, round_idx: int, best_perf: float,
@@ -85,6 +84,7 @@ def save_fit_state(path: str, *, variables: Dict[str, Any], opt_state: Any,
             jax.tree.map(np.asarray, variables)),
         "opt_state": serialization.to_state_dict(
             jax.tree.map(np.asarray, opt_state)),
+        "stamp": np.asarray([int(round_idx), int(epoch)]),
     }
     with open(path + ".msgpack.tmp", "wb") as fh:
         fh.write(serialization.msgpack_serialize(trees))
@@ -100,7 +100,7 @@ def save_fit_state(path: str, *, variables: Dict[str, Any], opt_state: Any,
         "rng_state": rng.bit_generator.state,
     }
     with open(path + ".json.tmp", "w") as fh:
-        _json.dump(meta, fh)
+        json.dump(meta, fh)
     os.replace(path + ".json.tmp", path + ".json")
 
 
@@ -111,11 +111,17 @@ def load_fit_state(path: str, round_idx: int) -> Optional[Dict[str, Any]]:
             and os.path.exists(path + ".json")):
         return None
     with open(path + ".json") as fh:
-        meta = _json.load(fh)
+        meta = json.load(fh)
     if meta.get("round_idx") != int(round_idx):
         return None
     with open(path + ".msgpack", "rb") as fh:
         trees = serialization.msgpack_restore(fh.read())
+    stamp = np.asarray(trees.pop("stamp", [-1, -1])).tolist()
+    if stamp != [meta["round_idx"], meta["epoch"]]:
+        # Torn or corrupt pair (a missing stamp included): the weight
+        # trees and the counters cannot be proven to be from the same
+        # epoch, so there is nothing safe to resume.
+        return None
     return {**meta, **trees}
 
 

@@ -324,6 +324,7 @@ class Trainer:
         metric_cb: Optional[Callable[[str, float, int], None]] = None,
         batch_hook: Optional[Callable[[int, Dict[str, np.ndarray]], None]]
         = None,
+        resume_fit_state: bool = True,
     ) -> FitResult:
         """Train on the labeled subset with per-epoch validation + early
         stopping (parallel_train_fn, strategy.py:304-381).
@@ -377,7 +378,18 @@ class Trainer:
         # VAE/discriminator state is not covered: with a batch_hook the
         # resumed fit restarts from epoch 1.
         start_epoch = 1
-        if weight_paths and batch_hook is None:
+        if weight_paths and batch_hook is None and not resume_fit_state:
+            # This fit starts from scratch by the caller's decision (a
+            # fresh, non-resumed experiment run).  A fit state on disk here
+            # is from an OLDER dead run of the same experiment directory —
+            # consuming it would silently splice two runs together.
+            if os.path.exists(weight_paths["fit_state"] + ".json"):
+                self.logger.warning(
+                    "Discarding a stale mid-round fit state from a "
+                    "previous run (start this run with --resume_training "
+                    "to consume it)")
+            ckpt_lib.delete_fit_state(weight_paths["fit_state"])
+        if weight_paths and batch_hook is None and resume_fit_state:
             saved = ckpt_lib.load_fit_state(weight_paths["fit_state"],
                                             round_idx)
             if saved is not None:
@@ -400,10 +412,30 @@ class Trainer:
                 key = jnp.asarray(np.asarray(saved["key"], dtype=np.uint32))
                 rng.bit_generator.state = saved["rng_state"]
                 start_epoch = int(saved["epoch"]) + 1
-                if best_epoch > 0 and os.path.exists(
-                        weight_paths["best_ckpt"]):
-                    best_variables = ckpt_lib.load_variables(
-                        weight_paths["best_ckpt"], like=host)
+                if best_epoch > 0:
+                    # The COORDINATOR's view of best_ckpt decides for every
+                    # process: this branch resets early-stopping control
+                    # state (es_count), and a per-process filesystem check
+                    # (NFS attribute-cache lag on a pod) could send
+                    # processes down different epoch counts — mismatched
+                    # collectives hang the job.
+                    have_best = os.path.exists(weight_paths["best_ckpt"])
+                    if mesh_lib.is_multiprocess(self.mesh):
+                        from jax.experimental import multihost_utils
+                        have_best = bool(multihost_utils.broadcast_one_to_all(
+                            np.uint8(have_best)))
+                    if have_best:
+                        best_variables = ckpt_lib.load_variables(
+                            weight_paths["best_ckpt"], like=host)
+                    else:
+                        # The weights best_perf refers to are gone; keeping
+                        # the stale score would make the no-improvement
+                        # fallback report it over final-epoch weights.
+                        self.logger.warning(
+                            f"fit-state references best epoch {best_epoch} "
+                            "but best_ckpt is missing; restarting "
+                            "best-model tracking")
+                        best_perf, best_epoch, es_count = 0.0, 0, 0
                 self.logger.info(
                     f"Resuming round {round_idx} training from epoch "
                     f"{start_epoch} (mid-round fit state)")
@@ -486,6 +518,13 @@ class Trainer:
                                             jax.tree.map(np.asarray,
                                                          state.variables))
             history.append(record)
+            if use_es and es_count > es_patience:
+                # Break BEFORE the periodic fit-state save: a state whose
+                # es_count is already past patience must never persist —
+                # resuming from it would train past the point where the
+                # uninterrupted run stopped.
+                self.logger.info("Early stopping criterion reached. ")
+                break
             if (weight_paths and batch_hook is None
                     and mesh_lib.is_coordinator()
                     and epoch % self.current_ckpt_every == 0
@@ -496,9 +535,6 @@ class Trainer:
                     round_idx=round_idx, best_perf=best_perf,
                     best_epoch=best_epoch, es_count=es_count, key=key,
                     rng=rng)
-            if use_es and es_count > es_patience:
-                self.logger.info("Early stopping criterion reached. ")
-                break
 
         if best_variables is None:
             best_epoch = epochs_run

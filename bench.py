@@ -50,12 +50,29 @@ PEAK_TFLOPS_BF16 = [
     ("v6", 918.0), ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
 ]
 
+# Successful phase results are persisted here (with a capture timestamp)
+# and reused — marked "cached": true — when a later invocation can't
+# capture that phase fresh.  The tunneled TPU backend's availability is
+# highly variable (whole-phase timeouts minutes apart from 3.5-minute
+# successes), and a flaky tunnel at harness time must not erase real
+# numbers captured hours earlier on the same hardware.
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_cache.json")
+
 PHASES = [
-    # (name, iters, per-chip batch, first-attempt timeout seconds)
-    ("resnet50_imagenet_train", 50, 128, 900),
-    ("resnet18_cifar_train", 200, 256, 600),
-    ("resnet50_imagenet_score", 30, 128, 600),
-    ("resnet18_cifar_score", 50, 256, 420),
+    # (name, iters, per-chip batch, first-attempt timeout seconds).
+    # Iteration counts are sized for timing stability on a HEALTHY backend
+    # while still fitting the first attempt when the tunnel runs several
+    # times slower than its best observed window.
+    ("resnet50_imagenet_train", 30, 128, 900),
+    ("resnet18_cifar_train", 100, 256, 600),
+    ("resnet50_imagenet_score", 20, 128, 600),
+    # ImageNet-scale data-path rehearsal (SURVEY hard part (e)): a 50k
+    # synthetic JPEG tree (1/25 of ImageNet) through ImageFolderDataset +
+    # native C++ decode + the mesh-parallel scoring pass.  iters is in
+    # THOUSANDS of images so the retry halving shrinks the tree.
+    ("imagenet_datapath", 50, 128, 900),
+    ("resnet18_cifar_score", 30, 256, 420),
 ]
 TOTAL_BUDGET_S = 3000.0  # stop launching attempts past this wall-clock
 
@@ -94,6 +111,130 @@ def _model_and_views(config: str):
             ViewSpec(CIFAR10_NORM, augment=False))
 
 
+def _ensure_jpeg_tree(root: str, n_images: int, n_classes: int = 100
+                      ) -> float:
+    """Synthetic ImageNet-like JPEG tree: ``n_classes`` class directories,
+    variable image sizes (224-320px), seeded per index so the tree is
+    reproducible and resumable.  ONE shared root that only ever grows: a
+    retry with a smaller target reuses the existing files (smaller runs
+    read a ``limit=`` of them), so generation cost is paid once, not per
+    attempt.  Returns generation seconds (0.0 when enough images exist)."""
+    import numpy as np
+    from PIL import Image
+
+    marker = os.path.join(root, ".generated")
+    have = 0
+    try:
+        with open(marker) as fh:
+            have = int(fh.read().strip() or 0)
+    except (OSError, ValueError):
+        pass
+    if have >= n_images:
+        return 0.0
+    t0 = time.perf_counter()
+    for c in range(n_classes):
+        os.makedirs(os.path.join(root, f"cls_{c:04d}"), exist_ok=True)
+    for i in range(n_images):
+        path = os.path.join(root, f"cls_{i % n_classes:04d}",
+                            f"img_{i:06d}.jpg")
+        if os.path.exists(path):
+            continue
+        rng = np.random.default_rng(i)
+        h = int(rng.integers(224, 321))
+        w = int(rng.integers(224, 321))
+        base = rng.integers(0, 256, size=(12, 16, 3), dtype=np.uint8)
+        Image.fromarray(base).resize((w, h), Image.BILINEAR).save(
+            path, quality=75)
+    with open(marker, "w") as fh:
+        fh.write(str(n_images))
+    return time.perf_counter() - t0
+
+
+def run_datapath_phase(n_images: int, per_chip: int) -> dict:
+    """End-to-end rehearsal of the ImageNet scoring data path: disk JPEGs
+    -> native C++ batch decode/crop/resize -> threaded prefetch ->
+    mesh-sharded ResNet-50 scoring via collect_pool (which also enforces
+    score/index alignment over the whole pass).  Reports the end-to-end
+    scoring rate, the decode-only rate, and the per-core decode rate —
+    the number that says how many host cores a full-size run needs to
+    keep the mesh fed."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from active_learning_tpu.data.core import IMAGENET_NORM, ViewSpec
+    from active_learning_tpu.data.imagenet import ImageFolderDataset
+    from active_learning_tpu.data.pipeline import iterate_batches
+    from active_learning_tpu.parallel import mesh as mesh_lib
+    from active_learning_tpu.strategies import scoring
+
+    root = os.path.join(tempfile.gettempdir(), "al_tpu_datapath")
+    gen_sec = _ensure_jpeg_tree(root, n_images)
+    mesh = mesh_lib.make_mesh(-1)
+    n_chips = int(mesh.devices.size)
+    batch_size = per_chip * n_chips
+    device_kind = jax.devices()[0].device_kind
+    cores = (len(os.sched_getaffinity(0))
+             if hasattr(os, "sched_getaffinity") else os.cpu_count() or 1)
+    threads = max(2, min(16, 2 * cores))
+    log(f"[imagenet_datapath] {n_images} JPEGs (gen {gen_sec:.0f}s), "
+        f"{n_chips}x {device_kind}, batch {batch_size}, {cores} host cores")
+
+    view = ViewSpec(IMAGENET_NORM, augment=False)
+    dataset = ImageFolderDataset(root, view, train_transform=False,
+                                 num_classes=1000, limit=n_images)
+    dataset.gather(np.arange(8))  # warm-up: builds/loads the native lib
+
+    # Decode-only: the host side in isolation (native decode + crop +
+    # resize + batch assembly through the threaded prefetcher).
+    n_decode = min(len(dataset), 5000)
+    t0 = time.perf_counter()
+    rows = 0
+    for b in iterate_batches(dataset, np.arange(n_decode), batch_size,
+                             num_threads=threads):
+        rows += int(b["mask"].sum())
+    decode_ips = rows / (time.perf_counter() - t0)
+
+    # Full scoring pass over the whole tree, decode overlapped with device
+    # compute exactly as a real acquisition round runs it.
+    model, _, _, _, score_view = _model_and_views("resnet50_imagenet")
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((8, 224, 224, 3), jnp.float32),
+                           train=False)
+    step = scoring.make_prob_stats_step(model, score_view)
+    # Untimed warm-up at the real batch shape: the jitted step's XLA
+    # compile (tens of seconds for ResNet-50 on TPU) must not pollute the
+    # measured pass, same as every other phase's 3 warm-up iterations.
+    scoring.collect_pool(dataset, np.arange(min(batch_size, len(dataset))),
+                         batch_size, step, variables, mesh,
+                         keys=("margin",))
+    all_idxs = np.arange(len(dataset))
+    t0 = time.perf_counter()
+    out = scoring.collect_pool(dataset, all_idxs, batch_size, step,
+                               variables, mesh, num_workers=threads,
+                               prefetch=4, keys=("margin",))
+    score_sec = time.perf_counter() - t0
+    assert len(out["margin"]) == len(dataset)
+    ips = len(dataset) / score_sec
+    return {
+        "phase": "imagenet_datapath",
+        "ips": round(ips, 1),
+        "ips_per_chip": round(ips / n_chips, 1),
+        "n_chips": n_chips,
+        "batch_per_chip": per_chip,
+        "n_images": len(dataset),
+        "decode_ips": round(decode_ips, 1),
+        "host_cores": cores,
+        "decode_ips_per_core": round(decode_ips / cores, 1),
+        "gen_sec": round(gen_sec, 1),
+        "score_sec": round(score_sec, 1),
+        "device_kind": device_kind,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def run_child_phase(phase: str, iters: int, per_chip: int) -> dict:
     import numpy as np
 
@@ -103,6 +244,8 @@ def run_child_phase(phase: str, iters: int, per_chip: int) -> dict:
     from active_learning_tpu.parallel import mesh as mesh_lib
     from active_learning_tpu.train.trainer import Trainer
 
+    if phase == "imagenet_datapath":
+        return run_datapath_phase(iters * 1000, per_chip)
     config, kind = phase.rsplit("_", 1)
     mesh = mesh_lib.make_mesh(-1)
     n_chips = int(mesh.devices.size)
@@ -283,25 +426,92 @@ def main() -> None:
         }), flush=True)
 
 
+def _probe_hardware(timeout: float = 120.0):
+    """(device_kind, n_devices) of the live backend via a subprocess, or
+    None when the backend is unreachable — which is exactly when the cache
+    fallback is being considered."""
+    code = ("import jax; d = jax.devices(); "
+            "print(d[0].device_kind + '|' + str(len(d)))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode == 0 and "|" in proc.stdout:
+            kind, n = proc.stdout.strip().rsplit("|", 1)
+            return kind, int(n)
+    except (subprocess.SubprocessError, ValueError, OSError):
+        pass
+    return None
+
+
+def _load_cache() -> dict:
+    try:
+        with open(CACHE_PATH) as fh:
+            cache = json.load(fh)
+        return cache if isinstance(cache, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _save_cache(cache: dict) -> None:
+    try:
+        tmp = f"{CACHE_PATH}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(cache, fh, indent=1)
+        os.replace(tmp, CACHE_PATH)
+    except OSError as e:
+        log(f"[parent] cache write failed: {e!r}")
+
+
 def _main_inner() -> None:
     start = time.monotonic()
     deadline = start + TOTAL_BUDGET_S
+    cache = _load_cache()
     phases: dict = {}
     failures: dict = {}
     for name, iters, per_chip, timeout in PHASES:
         result, failure = run_phase_with_retries(name, iters, per_chip,
                                                  timeout, deadline)
         if result is not None:
+            result["captured_utc"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
             phases[name] = result
+            cache[name] = result
+            _save_cache(cache)
             log(f"[parent] {name}: {result['ips']:,.0f} img/s total, "
                 f"{result['ips_per_chip']:,.0f} img/s/chip")
         else:
             failures[name] = failure
 
+    # Cache fallback for failed phases, AFTER the loop so the hardware
+    # probe never contends with a running phase.  Numbers captured on
+    # DIFFERENT hardware are never resurrected: reuse requires the cached
+    # device_kind/chip count to match the live backend (when the backend
+    # is unreachable — the usual reason for the fallback — the entry is
+    # marked device_unverified instead).
+    missing = [n for n in failures if n in cache]
+    if missing:
+        hw = _probe_hardware()
+        for name in missing:
+            entry = cache[name]
+            if hw is not None and (entry.get("device_kind"),
+                                   entry.get("n_chips")) != hw:
+                log(f"[parent] {name}: cached result is from "
+                    f"{entry.get('device_kind')} x{entry.get('n_chips')}, "
+                    f"live backend is {hw[0]} x{hw[1]}; not reusing")
+                continue
+            phases[name] = dict(entry, cached=True,
+                                fresh_failure=failures.pop(name))
+            if hw is None:
+                phases[name]["device_unverified"] = True
+            log(f"[parent] {name}: fresh capture failed; using cached "
+                f"result from {entry.get('captured_utc')}")
+
     # Headline: the north-star model if captured, else the CIFAR model.
     headline = None
     for name in ("resnet50_imagenet_train", "resnet18_cifar_train",
-                 "resnet50_imagenet_score", "resnet18_cifar_score"):
+                 "resnet50_imagenet_score", "resnet18_cifar_score",
+                 "imagenet_datapath"):
         if name in phases:
             headline = name
             break
@@ -319,6 +529,8 @@ def _main_inner() -> None:
         base = V100_BASELINE_IPS.get(headline)
         if base:
             out["vs_baseline"] = round(out["value"] / base, 3)
+        if phases[headline].get("cached"):
+            out["headline_cached"] = True
     if failures:
         out["failed_phases"] = failures
     print(json.dumps(out), flush=True)

@@ -38,7 +38,8 @@ def _flat(tree):
 
 @pytest.mark.parametrize("device_resident", [False, True])
 class TestMidRoundResume:
-    def _fit(self, tmp_path, tag, device_resident, metric_cb=None):
+    def _fit(self, tmp_path, tag, device_resident, metric_cb=None,
+             resume_fit_state=True):
         """One fit run from identical initial conditions."""
         import dataclasses
         train_set, _, al_set = get_data_synthetic(
@@ -55,7 +56,7 @@ class TestMidRoundResume:
             state, train_set, np.arange(48), al_set, np.arange(48, 64),
             n_epoch=N_EPOCH, es_patience=10,
             rng=np.random.default_rng(7), round_idx=1, weight_paths=paths,
-            metric_cb=metric_cb)
+            metric_cb=metric_cb, resume_fit_state=resume_fit_state)
         return result, paths
 
     def test_resume_matches_uninterrupted_run(self, tmp_path,
@@ -89,6 +90,129 @@ class TestMidRoundResume:
                                       _flat(ref.state.batch_stats))
         # And the resumed round also cleans up after itself.
         assert ckpt_lib.load_fit_state(res_paths["fit_state"], 1) is None
+
+    def test_torn_fit_state_save_is_rejected(self, tmp_path,
+                                             device_resident):
+        """A crash BETWEEN the msgpack and json os.replace calls leaves the
+        new weight trees paired with the old counters.  The shared epoch
+        stamp in both files must make load_fit_state treat that torn pair
+        as nothing-to-resume rather than silently mixing epochs."""
+        import json
+        _, paths = self._fit(tmp_path, "d", device_resident)
+        fs = paths["fit_state"]
+        ckpt_lib.save_fit_state(
+            fs, variables={"params": {"w": np.ones(2)}}, opt_state={},
+            step=np.int32(4), epoch=2, round_idx=1, best_perf=0.5,
+            best_epoch=2, es_count=0, key=np.zeros(2, np.uint32),
+            rng=np.random.default_rng(0))
+        with open(fs + ".json") as fh:
+            old_meta = fh.read()
+        ckpt_lib.save_fit_state(
+            fs, variables={"params": {"w": np.full(2, 9.0)}}, opt_state={},
+            step=np.int32(8), epoch=4, round_idx=1, best_perf=0.7,
+            best_epoch=4, es_count=0, key=np.zeros(2, np.uint32),
+            rng=np.random.default_rng(0))
+        # Simulate the torn save: epoch-4 msgpack on disk, epoch-2 json.
+        with open(fs + ".json", "w") as fh:
+            fh.write(old_meta)
+        assert ckpt_lib.load_fit_state(fs, 1) is None
+        assert json.loads(old_meta)["epoch"] == 2  # the tear was real
+
+    def test_no_fit_state_saved_past_early_stop(self, tmp_path,
+                                                device_resident):
+        """A fit state whose es_count already exceeds patience must never
+        be written: resuming from it would train PAST the point where the
+        uninterrupted run stopped."""
+        import dataclasses
+        train_set, _, al_set = get_data_synthetic(
+            n_train=64, n_test=16, num_classes=4, image_size=8, seed=11)
+        cfg = dataclasses.replace(tiny_train_config(batch_size=16),
+                                  device_resident=device_resident)
+        trainer = Trainer(BNClassifier(), cfg, mesh_lib.make_mesh(),
+                          num_classes=4, train_bn=True,
+                          current_ckpt_every=1)  # save cadence every epoch
+        state = trainer.init_state(jax.random.PRNGKey(0),
+                                   train_set.gather(np.arange(2)))
+        paths = ckpt_lib.weight_paths(str(tmp_path), "t", "es",
+                                      round_idx=1)
+        # Scripted validation curve: strictly declining after epoch 1, so
+        # with patience 1 the stop fires at epoch 3 (es_count 2) — exactly
+        # a save-cadence epoch.
+        accs = iter([0.9, 0.8, 0.7, 0.6, 0.5, 0.4])
+        trainer.evaluate = lambda s, d, i: {"accuracy": next(accs),
+                                            "top_5_accuracy": 1.0}
+        saved_counts = []
+        orig = ckpt_lib.save_fit_state
+
+        def recording(path, **kw):
+            saved_counts.append(kw["es_count"])
+            return orig(path, **kw)
+
+        ckpt_lib.save_fit_state = recording
+        try:
+            result = trainer.fit(state, train_set, np.arange(48), al_set,
+                                 np.arange(48, 64), n_epoch=6, es_patience=1,
+                                 rng=np.random.default_rng(7), round_idx=1,
+                                 weight_paths=paths)
+        finally:
+            ckpt_lib.save_fit_state = orig
+        assert result.epochs_run == 3  # the stop really fired at epoch 3
+        assert saved_counts, "cadence-1 fit never saved a fit state"
+        assert all(c <= 1 for c in saved_counts), saved_counts
+
+    def test_resume_with_missing_best_ckpt_restarts_best_tracking(
+            self, tmp_path, device_resident):
+        """fit-state says best_epoch=4/best_perf=0.99 but best_ckpt is
+        gone: the resume must NOT report the stale best_perf over weights
+        it no longer has — best tracking restarts and the reported best is
+        re-earned by the resumed epochs."""
+        import json
+
+        def boom(name, value, step):
+            if step == 5 and name.endswith("validation_accuracy"):
+                raise Boom()
+
+        with pytest.raises(Boom):
+            self._fit(tmp_path, "e", device_resident, metric_cb=boom)
+        fs = str(tmp_path / "t_e" / "fit_state_rd_1")
+        with open(fs + ".json") as fh:
+            meta = json.load(fh)
+        meta["best_perf"], meta["best_epoch"] = 0.99, 4  # unbeatable
+        with open(fs + ".json", "w") as fh:
+            json.dump(meta, fh)
+        os.remove(str(tmp_path / "t_e" / "best_rd_1.msgpack"))
+
+        resumed, paths = self._fit(tmp_path, "e", device_resident)
+        assert resumed.history[0]["epoch"] == 5  # really resumed
+        vals = [r["val_accuracy"] for r in resumed.history]
+        assert resumed.best_perf == max(vals)  # re-earned, not the stale .99
+        assert os.path.exists(paths["best_ckpt"])
+
+    def test_fresh_run_discards_stale_fit_state(self, tmp_path,
+                                                device_resident):
+        """``resume_fit_state=False`` (a fresh, non-resumed experiment over
+        an existing checkpoint dir): a fit state left by an older dead run
+        must be discarded, not consumed — otherwise the 'from scratch' run
+        silently splices in the dead run's weights."""
+        def boom(name, value, step):
+            if step == 5 and name.endswith("validation_accuracy"):
+                raise Boom()
+
+        with pytest.raises(Boom):
+            self._fit(tmp_path, "f", device_resident, metric_cb=boom)
+        fs = str(tmp_path / "t_f" / "fit_state_rd_1")
+        assert ckpt_lib.load_fit_state(fs, 1) is not None  # stale state
+
+        ref, _ = self._fit(tmp_path / "clean", "f", device_resident)
+        fresh, _ = self._fit(tmp_path, "f", device_resident,
+                             resume_fit_state=False)
+        # Started from epoch 1 (not 5) and matches a truly clean run.
+        assert fresh.history[0]["epoch"] == 1
+        assert fresh.epochs_run == ref.epochs_run
+        np.testing.assert_array_equal(_flat(fresh.state.params),
+                                      _flat(ref.state.params))
+        # And the stale state is gone from disk.
+        assert ckpt_lib.load_fit_state(fs, 1) is None
 
     def test_stale_state_from_other_round_is_ignored(self, tmp_path,
                                                      device_resident):
