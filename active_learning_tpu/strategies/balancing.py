@@ -85,6 +85,29 @@ def _mark_taken(eligible, idx):
     return eligible.at[idx].set(False)
 
 
+def device_pool_state(mesh, embeddings: np.ndarray, eligible: np.ndarray):
+    """Upload the pool once: embeddings + eligibility mask, padded to the
+    mesh size and sharded over the data axis.  Padded rows are ineligible
+    so they can never win the argmin.  On a multi-host mesh each process
+    contributes only its own row slice."""
+    n = embeddings.shape[0]
+    pad = (-n) % mesh.devices.size
+    emb = np.ascontiguousarray(
+        np.pad(embeddings.astype(np.float32), ((0, pad), (0, 0))))
+    elig = np.pad(eligible, (0, pad))
+    sharding = mesh_lib.batch_sharding(mesh)
+    if mesh_lib.is_multiprocess(mesh):
+        rows = mesh_lib.process_local_rows(mesh, n + pad)
+
+        def put(a):
+            return jax.make_array_from_process_local_data(
+                sharding, np.ascontiguousarray(a[rows]), a.shape)
+
+        return put(emb), put(elig)
+    return (jax.device_put(emb, sharding),
+            jax.device_put(elig, sharding))
+
+
 @register_strategy("BalancingSampler")
 class BalancingSampler(Strategy):
 
@@ -101,29 +124,6 @@ class BalancingSampler(Strategy):
         if self.cfg.freeze_feature:
             self._saved_embeddings = emb
         return emb
-
-    def _device_pool_state(self, embeddings: np.ndarray,
-                           eligible: np.ndarray):
-        """Upload the pool once: embeddings + eligibility mask, padded to
-        the mesh size and sharded over the data axis.  Padded rows are
-        ineligible so they can never win the argmin."""
-        mesh = self.mesh
-        n = embeddings.shape[0]
-        pad = (-n) % mesh.devices.size
-        emb = np.ascontiguousarray(
-            np.pad(embeddings.astype(np.float32), ((0, pad), (0, 0))))
-        elig = np.pad(eligible, (0, pad))
-        sharding = mesh_lib.batch_sharding(mesh)
-        if mesh_lib.is_multiprocess(mesh):
-            rows = mesh_lib.process_local_rows(mesh, n + pad)
-
-            def put(a):
-                return jax.make_array_from_process_local_data(
-                    sharding, np.ascontiguousarray(a[rows]), a.shape)
-
-            return put(emb), put(elig)
-        return (jax.device_put(emb, sharding),
-                jax.device_put(elig, sharding))
 
     def query(self, budget: int) -> Tuple[np.ndarray, int]:
         ys = self.al_set.targets[: len(self.al_set)]
@@ -166,8 +166,8 @@ class BalancingSampler(Strategy):
                 # device; only the centroids go down and one index comes
                 # back.
                 if emb_dev is None:
-                    emb_dev, eligible_dev = self._device_pool_state(
-                        embeddings, idxs_for_query)
+                    emb_dev, eligible_dev = device_pool_state(
+                        self.mesh, embeddings, idxs_for_query)
                 centers = (sums / (counts[:, None] + 1e-5)
                            ).astype(np.float32)
                 rarest = int(np.argmin(counts))

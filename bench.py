@@ -235,7 +235,27 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
     }
 
 
-def run_child_phase(phase: str, iters: int, per_chip: int) -> dict:
+def _flops_per_step(jitted, phase: str, *args, **kwargs):
+    """Per-device flops of one step via AOT lower/compile.  This is a
+    SECOND full XLA compile (it does not reuse the jit cache), so callers
+    emit their timing result BEFORE calling this — a backend that dies or
+    crawls inside the optional compile must not take a completed
+    measurement down with it."""
+    try:
+        cost = jitted.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception as e:
+        log(f"[{phase}] cost analysis unavailable: {e!r}")
+        return None
+
+
+def run_child_phase(phase: str, iters: int, per_chip: int):
+    """Yields the phase result dict, then — for train/score phases — the
+    same result enriched with flops/MFU.  The caller prints each as its
+    own JSON line and the parent keeps the LAST parseable one, so the
+    enrichment compile is strictly best-effort."""
     import numpy as np
 
     import jax
@@ -245,7 +265,8 @@ def run_child_phase(phase: str, iters: int, per_chip: int) -> dict:
     from active_learning_tpu.train.trainer import Trainer
 
     if phase == "imagenet_datapath":
-        return run_datapath_phase(iters * 1000, per_chip)
+        yield run_datapath_phase(iters * 1000, per_chip)
+        return
     config, kind = phase.rsplit("_", 1)
     mesh = mesh_lib.make_mesh(-1)
     n_chips = int(mesh.devices.size)
@@ -270,7 +291,6 @@ def run_child_phase(phase: str, iters: int, per_chip: int) -> dict:
     state = trainer.init_state(jax.random.PRNGKey(0),
                                host_batch["image"][:min(8, batch_size)])
 
-    flops_per_step = None
     if kind == "train":
         class_weights = jnp.ones(n_classes, jnp.float32)
         lr = jnp.float32(0.1)
@@ -290,15 +310,10 @@ def run_child_phase(phase: str, iters: int, per_chip: int) -> dict:
             state, key, loss = step(state, key)
         float(loss)  # data-dependent on every step via the state chain
         dt = time.perf_counter() - t0
-        try:
-            lowered = trainer._train_step.lower(
-                state, batch, key, lr, class_weights, view=train_view)
-            cost = lowered.compile().cost_analysis()
-            if isinstance(cost, list):
-                cost = cost[0]
-            flops_per_step = float(cost.get("flops", 0.0)) or None
-        except Exception as e:
-            log(f"[{phase}] cost analysis unavailable: {e!r}")
+
+        def flops_fn():
+            return _flops_per_step(trainer._train_step, phase, state, batch,
+                                   key, lr, class_weights, view=train_view)
     else:
         from active_learning_tpu.strategies import scoring
 
@@ -320,6 +335,9 @@ def run_child_phase(phase: str, iters: int, per_chip: int) -> dict:
         float(carry)
         dt = time.perf_counter() - t0
 
+        def flops_fn():
+            return _flops_per_step(sstep, phase, variables, sbatch)
+
     ips = batch_size * iters / dt
     result = {
         "phase": phase,
@@ -331,6 +349,8 @@ def run_child_phase(phase: str, iters: int, per_chip: int) -> dict:
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
     }
+    yield dict(result)  # the measurement is safe with the parent now
+    flops_per_step = flops_fn()
     if flops_per_step:
         # cost_analysis on a jitted SPMD executable reports the PER-DEVICE
         # partitioned module's flops (verified empirically: an 8-way
@@ -343,7 +363,7 @@ def run_child_phase(phase: str, iters: int, per_chip: int) -> dict:
         if peak:
             result["mfu"] = round(tflops_chip / peak, 3)
             result["peak_tflops_per_chip"] = peak
-    return result
+        yield result
 
 
 # ---------------------------------------------------------------------------
@@ -543,7 +563,8 @@ if __name__ == "__main__":
     parser.add_argument("--per-chip-batch", type=int, default=128)
     args = parser.parse_args()
     if args.phase:
-        print(json.dumps(run_child_phase(args.phase, args.iters,
-                                         args.per_chip_batch)), flush=True)
+        for result in run_child_phase(args.phase, args.iters,
+                                      args.per_chip_batch):
+            print(json.dumps(result), flush=True)
     else:
         main()

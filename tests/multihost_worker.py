@@ -70,7 +70,32 @@ def main() -> None:
     scores = scoring.collect_pool(al_set, np.arange(48, 64), bs, step,
                                   result.state.variables, mesh)
 
+    # BalancingSampler's device pick loop across processes: the sharded
+    # pool upload takes the make_array_from_process_local_data branch, and
+    # the argmin + eligibility scatter run as cross-process SPMD.  Inputs
+    # are seeded so every process (and the single-process oracle in
+    # test_multihost.py) computes from identical data; 37 rows on 4
+    # devices also exercises the pad-row ineligibility.
+    from active_learning_tpu.strategies.balancing import (
+        _balancing_pick, _mark_taken, device_pool_state)
+    brng = np.random.default_rng(5)
+    emb = brng.normal(size=(37, 6)).astype(np.float32)
+    eligible = np.ones(37, bool)
+    eligible[::7] = False
+    centers = brng.normal(size=(4, 6)).astype(np.float32)
+    maj = np.array([True, True, False, False])
+    emb_dev, elig_dev = device_pool_state(mesh, emb, eligible)
+    picks = []
+    for _ in range(4):
+        small = mesh_lib.replicate(
+            (centers, maj, np.int32(2), np.bool_(False)), mesh)
+        q = int(_balancing_pick(emb_dev, elig_dev, *small))
+        elig_dev = _mark_taken(elig_dev,
+                               mesh_lib.replicate(np.int32(q), mesh))
+        picks.append(q)
+
     out = {
+        "balancing_picks": picks,
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
         "n_devices_global": int(mesh.devices.size),

@@ -139,3 +139,31 @@ class TestTwoProcessSmoke:
         assert by_pid[0]["param_sum"] == pytest.approx(oracle_sum, rel=1e-5)
         np.testing.assert_allclose(np.array(by_pid[0]["margin"]),
                                    oracle_margin, rtol=1e-5, atol=1e-6)
+
+        # BalancingSampler's cross-process pick loop: both processes agree
+        # and match the host-NumPy selection over the same seeded inputs.
+        assert by_pid[0]["balancing_picks"] == by_pid[1]["balancing_picks"]
+        assert by_pid[0]["balancing_picks"] == _balancing_picks_oracle()
+
+
+def _balancing_picks_oracle():
+    """Host-NumPy replay of the worker's 4 seeded balancing picks."""
+    brng = np.random.default_rng(5)
+    emb = brng.normal(size=(37, 6)).astype(np.float32)
+    eligible = np.ones(37, bool)
+    eligible[::7] = False
+    centers = brng.normal(size=(4, 6)).astype(np.float32)
+    maj = np.array([True, True, False, False])
+    rarest = 2
+    picks = []
+    for _ in range(4):
+        d_rare = ((emb - centers[rarest]) ** 2).sum(1)
+        a2 = (emb ** 2).sum(1, keepdims=True)
+        b2 = (centers ** 2).sum(1)[None, :]
+        d_all = a2 + b2 - 2.0 * emb @ centers.T
+        norm = np.where(maj[None, :], d_all, -np.inf).max(1)
+        score = np.where(eligible, d_rare / norm, np.inf)
+        q = int(np.argmin(score))
+        eligible[q] = False
+        picks.append(q)
+    return picks
