@@ -96,6 +96,11 @@ class TrainConfig:
     # images fit in HBM and the labeled set is large enough to amortize
     # the extra compile), True = force on, False = host-batched path.
     device_resident: Optional[bool] = None
+    # Epoch cadence for the current-weights checkpoint AND the mid-round
+    # fit-state save (the reference writes rd_{n}.pth every epoch,
+    # strategy.py:440; a full-variable host transfer per epoch would
+    # dominate small-model epochs on TPU, so both are periodic here).
+    current_ckpt_every: int = 25
 
     @property
     def has_pretrained(self) -> bool:

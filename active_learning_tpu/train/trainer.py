@@ -79,11 +79,13 @@ class Trainer:
 
     def __init__(self, model, train_cfg: TrainConfig, mesh,
                  num_classes: int, train_bn: Optional[bool] = None,
-                 current_ckpt_every: int = 25):
+                 current_ckpt_every: Optional[int] = None):
         self.model = model
         self.cfg = train_cfg
         self.mesh = mesh
         self.num_classes = num_classes
+        if current_ckpt_every is None:
+            current_ckpt_every = train_cfg.current_ckpt_every
         self.current_ckpt_every = max(1, int(current_ckpt_every))
         self.logger = get_logger()
         self.tx = make_optimizer(train_cfg.optimizer)

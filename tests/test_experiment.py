@@ -135,6 +135,73 @@ def test_resume_reproduces_identical_round2_query(tmp_path):
     jax.tree.map(np.testing.assert_array_equal, va, vb)
 
 
+def test_mid_round_crash_resumes_from_saved_epoch(tmp_path):
+    """Driver-level epoch recovery: a run killed mid-fit of round 1
+    relaunched with --resume_training continues that round from the last
+    saved fit-state epoch (not epoch 1) and lands on the same best round-1
+    weights as an uninterrupted run — the full wiring of
+    strategy.resume_next_fit through Trainer.fit."""
+    import dataclasses
+
+    import jax
+
+    from active_learning_tpu.train import checkpoint as ckpt_lib
+
+    class Boom(Exception):
+        pass
+
+    class BoomSink(JsonlSink):
+        def log_metric(self, name, value, step=None):
+            if name == "rd_1_validation_accuracy" and step == 5:
+                raise Boom()
+            super().log_metric(name, value, step=step)
+
+    tcfg = dataclasses.replace(tiny_train_config(), current_ckpt_every=2,
+                               device_resident=False)
+    data = get_data_synthetic(n_train=96, n_test=32, num_classes=4,
+                              image_size=8, seed=5)
+
+    def run(name, rounds, sink_cls, resume=False, log_name=None):
+        # The resumed run gets its OWN metrics file (same ckpt_path), so
+        # step assertions below can't see the crashed run's events.
+        cfg = _cfg(tmp_path, name, rounds=rounds, n_epoch=6,
+                   early_stop_patience=10, resume_training=resume,
+                   log_dir=str(tmp_path / f"logs_{log_name or name}"))
+        sink = sink_cls(cfg.log_dir, experiment_key=name)
+        strategy = run_experiment(cfg, sink=sink, data=data, train_cfg=tcfg,
+                                  model=TinyClassifier(num_classes=4))
+        return cfg, strategy
+
+    # Oracle: uninterrupted 2-round run.
+    cfg_full, _ = run("mrfull", 2, JsonlSink)
+
+    # Crash mid-epoch-5 of round 1 (round 0 completed and saved).
+    with pytest.raises(Boom):
+        run("mrcrash", 2, BoomSink)
+    fs = os.path.join(tmp_path / "ckpt_mrcrash", "e2e_mrcrash",
+                      "fit_state_rd_1")
+    saved = ckpt_lib.load_fit_state(fs, 1)
+    assert saved is not None and saved["epoch"] == 4
+
+    # Resume: round 1 continues from epoch 5, not from scratch.
+    cfg_res, strategy = run("mrcrash", 2, JsonlSink, resume=True,
+                            log_name="mrres")
+    steps = []
+    for e in _read_metrics(cfg_res.log_dir):
+        if e["kind"] == "metric" and "rd_1_validation_accuracy" in e["metrics"]:
+            steps.append(e["step"])
+    assert min(steps) == 5, steps
+    assert strategy.round == 1
+    # Completed round cleaned up its fit state.
+    assert ckpt_lib.load_fit_state(fs, 1) is None
+    # Bit-identical round-1 best weights vs the uninterrupted run.
+    va = ckpt_lib.load_variables(os.path.join(
+        cfg_full.ckpt_path, "e2e_mrfull", "best_rd_1.msgpack"))
+    vb = ckpt_lib.load_variables(os.path.join(
+        cfg_res.ckpt_path, "e2e_mrcrash", "best_rd_1.msgpack"))
+    jax.tree.map(np.testing.assert_array_equal, va, vb)
+
+
 def test_resume_skips_completed_rounds(tmp_path):
     cfg = _cfg(tmp_path, "skip", rounds=2)
     strategy_1, _ = _run(cfg, tmp_path, "skip")
