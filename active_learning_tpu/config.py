@@ -101,6 +101,12 @@ class TrainConfig:
     # strategy.py:440; a full-variable host transfer per epoch would
     # dominate small-model epochs on TPU, so both are periodic here).
     current_ckpt_every: int = 25
+    # Cache decoded eval rows across validation epochs for disk-backed
+    # datasets (the val view is deterministic, so decoding each eval row
+    # once per ROUND instead of once per EPOCH is exact); bounded by
+    # cache_eval_bytes, falling back to per-epoch decode past the budget.
+    cache_eval: bool = True
+    cache_eval_bytes: int = 4 << 30
 
     @property
     def has_pretrained(self) -> bool:

@@ -1,0 +1,79 @@
+"""Decode-once cache for deterministic dataset views.
+
+The per-epoch validation loop re-reads the SAME eval rows every epoch
+(reference: a fresh DataLoader pass over the val subset per epoch,
+strategy.py:383-398).  For in-memory datasets that is a cheap array
+gather, but for disk-backed ImageNet it is thousands of JPEG
+decode+resize operations repeated up to n_epoch times per round.  The
+al/test views are deterministic — ``gather(i)`` is time-invariant
+(data/imagenet.py val transform, independent of ``set_epoch``) — so the
+decoded uint8 rows can be cached after the first epoch.
+
+Memory-bounded: rows are cached until ``max_bytes`` is reached; rows past
+the budget fall through to the wrapped dataset every time, so a too-large
+eval split degrades to the uncached behavior instead of exhausting host
+RAM.  Admitted rows are COPIES, never views into a gathered batch — a
+view would pin the whole batch while the byte accounting counted one row.
+Thread-safe: the eval pipeline gathers batches from ``num_workers``
+threads concurrently (data/pipeline.py), so all cache bookkeeping is
+under a lock (decode itself runs outside it; a duplicate concurrent
+decode of the same deterministic row is benign).  On a multi-host mesh
+each process only ever gathers (and therefore caches) its own rows.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import numpy as np
+
+from .core import Dataset
+
+
+class CachedEvalRows:
+    """Wrap a dataset whose active view is deterministic; same gather
+    contract, rows served from memory after first decode.
+
+    Only sound for augmentation-free views — wrapping a train view would
+    freeze the first epoch's crops forever, so callers gate on the view.
+    """
+
+    def __init__(self, dataset: Dataset, max_bytes: int = 4 << 30):
+        self.dataset = dataset
+        self.view = dataset.view
+        self.targets = dataset.targets
+        self.num_classes = dataset.num_classes
+        self._rows: Dict[int, np.ndarray] = {}
+        self._bytes = 0
+        self._max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def gather(self, idxs: np.ndarray) -> np.ndarray:
+        idxs = np.asarray(idxs)
+        if len(idxs) == 0:
+            # Preserve the wrapped dataset's empty-gather shape contract
+            # (a multi-host last batch can leave a process zero real rows).
+            return self.dataset.gather(idxs)
+        with self._lock:
+            missing = sorted({int(i) for i in idxs} - self._rows.keys())
+        fetched: Dict[int, np.ndarray] = {}
+        if missing:
+            rows = self.dataset.gather(np.asarray(missing, dtype=np.int64))
+            with self._lock:
+                for i, row in zip(missing, rows):
+                    fetched[i] = row
+                    if (i not in self._rows
+                            and self._bytes + row.nbytes <= self._max_bytes):
+                        self._rows[i] = row.copy()
+                        self._bytes += row.nbytes
+        out = []
+        with self._lock:
+            for j in idxs:
+                i = int(j)
+                row = self._rows.get(i)
+                out.append(row if row is not None else fetched[i])
+        return np.stack(out)

@@ -63,6 +63,100 @@ class TestDecodeRNG:
         assert a.shape == (1, 224, 224, 3)
 
 
+class TestEvalDecodeCache:
+    def test_cached_rows_exact_and_decode_once(self, jpeg_tree):
+        from active_learning_tpu.data.cache import CachedEvalRows
+        ds = make_ds(jpeg_tree, train=False)
+        calls = {"n": 0}
+        orig = ds.gather
+
+        def counting(idxs):
+            calls["n"] += len(idxs)
+            return orig(idxs)
+
+        ds.gather = counting
+        cache = CachedEvalRows(ds)
+        idxs = np.asarray([5, 2, 9, 2])
+        a = cache.gather(idxs)
+        np.testing.assert_array_equal(a, orig(idxs))
+        assert calls["n"] == 3  # unique rows only
+        b = cache.gather(idxs)
+        np.testing.assert_array_equal(a, b)
+        assert calls["n"] == 3  # second pass: zero decodes
+
+    def test_empty_gather_preserves_shape_contract(self, jpeg_tree):
+        """A multi-host last batch can leave a process zero real rows; the
+        cache must pass the empty gather through, not np.stack([])."""
+        from active_learning_tpu.data.cache import CachedEvalRows
+        ds = make_ds(jpeg_tree, train=False)
+        cache = CachedEvalRows(ds)
+        empty = cache.gather(np.zeros(0, dtype=np.int64))
+        assert empty.shape == ds.gather(np.zeros(0, dtype=np.int64)).shape
+        assert empty.shape[0] == 0
+
+    def test_concurrent_gathers_consistent_and_within_budget(self,
+                                                             jpeg_tree):
+        """The eval pipeline gathers from num_workers threads; hammering
+        the cache concurrently must stay exact and never admit past the
+        byte budget."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from active_learning_tpu.data.cache import CachedEvalRows
+        ds = make_ds(jpeg_tree, train=False)
+        want = ds.gather(np.arange(18))
+        row_bytes = want[0].nbytes
+        cache = CachedEvalRows(ds, max_bytes=10 * row_bytes)
+        batches = [np.asarray(b) for b in
+                   (range(0, 6), range(6, 12), range(12, 18),
+                    range(3, 9), range(9, 15), range(0, 18))] * 4
+        with ThreadPoolExecutor(max_workers=6) as ex:
+            results = list(ex.map(cache.gather, batches))
+        for idxs, got in zip(batches, results):
+            np.testing.assert_array_equal(got, want[idxs])
+        assert cache._bytes <= 10 * row_bytes
+        assert len(cache._rows) <= 10
+
+    def test_budget_overflow_falls_through_exactly(self, jpeg_tree):
+        from active_learning_tpu.data.cache import CachedEvalRows
+        ds = make_ds(jpeg_tree, train=False)
+        cache = CachedEvalRows(ds, max_bytes=1)
+        idxs = np.asarray([1, 4])
+        a = cache.gather(idxs)
+        b = cache.gather(idxs)
+        np.testing.assert_array_equal(a, ds.gather(idxs))
+        np.testing.assert_array_equal(a, b)
+
+    def test_fit_decodes_eval_rows_once_per_round(self, jpeg_tree):
+        """Through Trainer.fit: a 3-epoch fit over a disk dataset decodes
+        each eval row ONCE, not once per epoch (and the padding row reuse
+        comes along for free)."""
+        import jax
+
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.train.trainer import Trainer
+        from helpers import TinyClassifier, tiny_train_config
+
+        train_ds = make_ds(jpeg_tree, train=True)
+        al_ds = make_ds(jpeg_tree, train=False)
+        calls = {"n": 0}
+        orig = al_ds.gather
+
+        def counting(idxs):
+            calls["n"] += len(idxs)
+            return orig(idxs)
+
+        al_ds.gather = counting
+        trainer = Trainer(TinyClassifier(num_classes=3),
+                          tiny_train_config(batch_size=8),
+                          mesh_lib.make_mesh(), num_classes=3)
+        state = trainer.init_state(jax.random.PRNGKey(0),
+                                   train_ds.gather(np.arange(2)))
+        trainer.fit(state, train_ds, np.arange(12), al_ds,
+                    np.arange(12, 18), n_epoch=3, es_patience=5,
+                    rng=np.random.default_rng(0))
+        assert calls["n"] == 6, calls["n"]  # 6 eval rows, 3 epochs
+
+
 class TestThreadedPipeline:
     def test_threaded_matches_sync_in_order(self, jpeg_tree):
         ds = make_ds(jpeg_tree)
