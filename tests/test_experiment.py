@@ -212,6 +212,19 @@ def test_resume_skips_completed_rounds(tmp_path):
                                   strategy_1.pool.labeled)
 
 
+def test_profile_dir_captures_xla_trace(tmp_path):
+    """--profile_dir wraps the whole run in a jax.profiler trace
+    (utils/tracing.py profiler_session); the capture must produce trace
+    artifacts on disk."""
+    profile_dir = tmp_path / "trace"
+    cfg = _cfg(tmp_path, "prof", rounds=1, strategy="RandomSampler",
+               profile_dir=str(profile_dir))
+    _run(cfg, tmp_path, "prof")
+    names = [f for _, _, fs in os.walk(profile_dir) for f in fs]
+    assert any("trace" in f or f.endswith(".pb") or f.endswith(".json.gz")
+               for f in names), names
+
+
 class TestGenJobs:
     def test_every_job_parses_and_names_registered_components(self):
         """The sweep printer must stay in sync with the CLI flag surface
