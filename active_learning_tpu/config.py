@@ -107,6 +107,12 @@ class TrainConfig:
     # cache_eval_bytes, falling back to per-epoch decode past the budget.
     cache_eval: bool = True
     cache_eval_bytes: int = 4 << 30
+    # Keep in-memory pool images resident on device (replicated) for ALL
+    # rounds' acquisition scoring when they fit under this size — one
+    # upload per experiment instead of one per scoring pass.  0 disables;
+    # lower it on small-HBM chips where a ~2 GiB pinned pool could crowd
+    # out later-round training.
+    resident_scoring_bytes: int = 2 ** 31
 
     @property
     def has_pretrained(self) -> bool:

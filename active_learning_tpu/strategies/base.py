@@ -80,6 +80,10 @@ class Strategy:
         self.mesh = trainer.mesh
         self.state: Optional[TrainState] = None
         self.best_epoch: int = 0
+        # Device-resident scoring pool: in-memory pool images live on
+        # device for the WHOLE experiment (scoring.collect_pool fast
+        # path); one upload serves every round's every sampler.
+        self._resident_pool: Dict = {}
         # True only for the first train() after a genuine experiment
         # resume (the driver sets it): that is the one fit allowed to
         # consume a mid-round fit state from disk; trainer.fit discards
@@ -277,11 +281,13 @@ class Strategy:
         """Mesh-parallel scoring pass over ``al_set[idxs]`` returning host
         arrays aligned with ``idxs``."""
         loader = self.train_cfg.loader_te
+        rb = self.train_cfg.resident_scoring_bytes
         return scoring.collect_pool(
             self.al_set, idxs, self._score_batch_size(),
             self._get_score_step(kind), self.state.variables, self.mesh,
             num_workers=loader.num_workers, prefetch=loader.prefetch,
-            keys=keys)
+            keys=keys, resident_cache=self._resident_pool if rb else None,
+            resident_max_bytes=rb)
 
 
 def register_strategy(name: str):

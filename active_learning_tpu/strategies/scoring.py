@@ -165,6 +165,64 @@ def make_mase_step(model, view: ViewSpec) -> Callable:
     return step
 
 
+# In-memory pools up to this size stay resident on device across ALL
+# rounds and samplers (uint8, replicated like the trainer's epoch-scan
+# arrays; the per-batch gather output is what gets data-sharded).
+RESIDENT_MAX_BYTES = 2 ** 31
+
+
+def _resident_images(cache: Dict, dataset: Dataset, mesh):
+    """The pool's images, uploaded ONCE per (dataset, experiment) — the
+    images never change across AL rounds, only the labeled mask does, so
+    re-uploading them for every round's every scoring pass (as the host
+    path must) is pure waste.
+
+    The cache entry RETAINS the dataset object alongside the device
+    array: keys are id(dataset), and without the reference a
+    garbage-collected short-lived wrapper could hand its id to a new
+    dataset that would then silently score the wrong images."""
+    images = cache.setdefault("images", {})
+    key = id(dataset)
+    if key not in images:
+        n = len(dataset)
+        # replicate() device_puts EXPLICITLY (transfer-guard friendly).
+        images[key] = (dataset, mesh_lib.replicate(
+            np.ascontiguousarray(dataset.images[:n]), mesh))
+    return images[key][1]
+
+
+def _resident_runner(cache: Dict, step_fn: Callable, mesh):
+    """Jitted gather+score: rows are picked out of the resident pool ON
+    DEVICE and constrained to the batch sharding, so each scoring batch
+    costs one tiny [batch]-int32 transfer instead of the full image
+    rows."""
+    steps = cache.setdefault("steps", {})
+    key = id(step_fn)
+    if key not in steps:
+        batch_sharding = mesh_lib.batch_sharding(mesh)
+
+        @jax.jit
+        def run(variables, images, ids, mask):
+            batch = {
+                "image": jax.lax.with_sharding_constraint(
+                    images[ids], batch_sharding),
+                "mask": mask,
+            }
+            return step_fn(variables, batch)
+
+        steps[key] = run
+    return steps[key]
+
+
+def _finalize(chunks: Dict[str, list], multi: bool, mesh, n: int
+              ) -> Dict[str, np.ndarray]:
+    if multi:
+        return {k: np.asarray(mesh_lib.fetch(jnp.concatenate(v, axis=0),
+                                             mesh))[:n]
+                for k, v in chunks.items()}
+    return {k: np.concatenate(v, axis=0)[:n] for k, v in chunks.items()}
+
+
 def collect_pool(
     dataset: Dataset,
     idxs: np.ndarray,
@@ -175,6 +233,8 @@ def collect_pool(
     num_workers: int = 0,
     prefetch: int = 2,
     keys: Optional[Iterable[str]] = None,
+    resident_cache: Optional[Dict] = None,
+    resident_max_bytes: int = RESIDENT_MAX_BYTES,
 ) -> Dict[str, np.ndarray]:
     """Run ``step_fn`` over ``dataset[idxs]`` in fixed-shape sharded batches
     and return host arrays of length ``len(idxs)``, row i scoring pool index
@@ -195,6 +255,26 @@ def collect_pool(
     if n == 0:
         raise ValueError("collect_pool called with empty idxs; guard the "
                          "exhausted-pool case in the sampler")
+    # Device-resident fast path for in-memory pools: upload once per
+    # experiment (the caller owns ``resident_cache``), then every batch of
+    # every round's every sampler is an on-device gather — zero image
+    # bytes cross the host<->device boundary after the first round.
+    if (resident_cache is not None
+            and isinstance(getattr(dataset, "images", None), np.ndarray)
+            and dataset.images[:len(dataset)].nbytes <= resident_max_bytes):
+        images_dev = _resident_images(resident_cache, dataset, mesh)
+        run = _resident_runner(resident_cache, step_fn, mesh)
+        multi = mesh_lib.is_multiprocess(mesh)
+        chunks: Dict[str, list] = {}
+        for b in batch_index_lists(idxs, batch_size):
+            ids, mask = padded_batch_layout(b, batch_size)
+            small = mesh_lib.replicate((ids.astype(np.int32), mask), mesh)
+            out = run(variables, images_dev, *small)
+            if keys is not None:
+                out = {k: out[k] for k in keys}
+            for k, v in out.items():
+                chunks.setdefault(k, []).append(v if multi else np.asarray(v))
+        return _finalize(chunks, multi, mesh, n)
     # On a multi-host mesh each process gathers/decodes only its own rows
     # of every global batch; score rows come back in GLOBAL batch order
     # (mesh_lib.fetch all-gathers sharded outputs), so the global row
@@ -224,8 +304,4 @@ def collect_pool(
             # after the loop — a per-batch gather would serialize a DCN
             # round-trip into every step of the acquisition hot path.
             chunks.setdefault(k, []).append(v if multi else np.asarray(v))
-    if multi:
-        return {k: np.asarray(mesh_lib.fetch(jnp.concatenate(v, axis=0),
-                                             mesh))[:n]
-                for k, v in chunks.items()}
-    return {k: np.concatenate(v, axis=0)[:n] for k, v in chunks.items()}
+    return _finalize(chunks, multi, mesh, n)

@@ -1,0 +1,87 @@
+"""Device-resident acquisition scoring (8-device CPU mesh).
+
+In-memory pool images never change across AL rounds, so
+scoring.collect_pool keeps them resident on device for the whole
+experiment: one upload serves every round's every sampler, and each
+scoring batch moves only a [batch]-int32 index vector to the device.
+"""
+
+import numpy as np
+
+from active_learning_tpu.strategies import scoring
+
+from helpers import make_strategy
+
+
+class TestResidentScoring:
+    def test_matches_host_batched_path_exactly(self):
+        s = make_strategy("MarginSampler", n_train=96)
+        idxs = np.arange(len(s.al_set), dtype=np.int64)
+        step = s._get_score_step("prob_stats")
+        host = scoring.collect_pool(
+            s.al_set, idxs, s._score_batch_size(), step,
+            s.state.variables, s.mesh)
+        resident = scoring.collect_pool(
+            s.al_set, idxs, s._score_batch_size(), step,
+            s.state.variables, s.mesh, resident_cache={})
+        assert set(host) == set(resident)
+        for k in host:
+            np.testing.assert_allclose(resident[k], host[k],
+                                       rtol=1e-6, atol=1e-6, err_msg=k)
+
+    def test_no_host_gathers_and_one_upload_across_rounds(self):
+        """Two full query rounds: the pool's images are uploaded once and
+        the dataset's host gather is never called for scoring."""
+        s = make_strategy("MarginSampler", n_train=96)
+        calls = {"n": 0}
+        orig = s.al_set.gather
+
+        def counting(idxs):
+            calls["n"] += 1
+            return orig(idxs)
+
+        s.al_set.gather = counting
+        got1, cost1 = s.query(8)
+        s.update(got1, cost1)
+        got2, cost2 = s.query(8)
+        assert cost1 == 8 and cost2 == 8
+        assert not np.isin(got2, got1).any()
+        assert calls["n"] == 0  # zero host image gathers across rounds
+        assert len(s._resident_pool["images"]) == 1  # one upload total
+
+    def test_zero_budget_disables_resident_path(self):
+        """resident_scoring_bytes=0 must fall back to host-batched scoring
+        (no upload, host gathers happen)."""
+        import dataclasses
+        s = make_strategy("MarginSampler", n_train=64)
+        s.train_cfg = dataclasses.replace(s.train_cfg,
+                                          resident_scoring_bytes=0)
+        calls = {"n": 0}
+        orig = s.al_set.gather
+
+        def counting(idxs):
+            calls["n"] += 1
+            return orig(idxs)
+
+        s.al_set.gather = counting
+        got, cost = s.query(4)
+        assert cost == 4
+        assert calls["n"] > 0  # host path used
+        assert "images" not in s._resident_pool  # nothing uploaded
+
+    def test_embedding_samplers_share_the_resident_pool(self):
+        """Coreset then BADGE-style scoring over the same strategy reuse
+        the single uploaded pool (different step fns, same images)."""
+        s = make_strategy("CoresetSampler", n_train=96)
+        got, cost = s.query(6)
+        assert cost == 6
+        s.update(got, cost)
+        # A second scoring pass of a DIFFERENT kind over the same pool.
+        idxs = s.available_query_idxs(shuffle=False)
+        out = scoring.collect_pool(
+            s.al_set, idxs, s._score_batch_size(),
+            s._get_score_step("prob_stats"), s.state.variables, s.mesh,
+            resident_cache=s._resident_pool)
+        assert len(out["margin"]) == len(idxs)
+        assert len(s._resident_pool["images"]) == 1
+        assert len(s._resident_pool["steps"]) >= 2  # embed + prob_stats
