@@ -11,6 +11,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
 
+# Default budget for device-resident acquisition-scoring pools (see
+# TrainConfig.resident_scoring_bytes and strategies/scoring.py).
+RESIDENT_SCORING_BYTES_DEFAULT = 2 ** 31
+
 
 @dataclasses.dataclass(frozen=True)
 class LoaderConfig:
@@ -112,7 +116,7 @@ class TrainConfig:
     # upload per experiment instead of one per scoring pass.  0 disables;
     # lower it on small-HBM chips where a ~2 GiB pinned pool could crowd
     # out later-round training.
-    resident_scoring_bytes: int = 2 ** 31
+    resident_scoring_bytes: int = RESIDENT_SCORING_BYTES_DEFAULT
 
     @property
     def has_pretrained(self) -> bool:

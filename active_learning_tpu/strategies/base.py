@@ -281,13 +281,19 @@ class Strategy:
         """Mesh-parallel scoring pass over ``al_set[idxs]`` returning host
         arrays aligned with ``idxs``."""
         loader = self.train_cfg.loader_te
-        rb = self.train_cfg.resident_scoring_bytes
         return scoring.collect_pool(
             self.al_set, idxs, self._score_batch_size(),
             self._get_score_step(kind), self.state.variables, self.mesh,
             num_workers=loader.num_workers, prefetch=loader.prefetch,
-            keys=keys, resident_cache=self._resident_pool if rb else None,
-            resident_max_bytes=rb)
+            keys=keys, **self._resident_kwargs())
+
+    def _resident_kwargs(self) -> Dict:
+        """collect_pool kwargs for the device-resident pool: one gating
+        convention (resident_scoring_bytes == 0 disables) for every
+        sampler, including VAAL's own scoring pass."""
+        rb = self.train_cfg.resident_scoring_bytes
+        return {"resident_cache": self._resident_pool if rb else None,
+                "resident_max_bytes": rb}
 
 
 def register_strategy(name: str):

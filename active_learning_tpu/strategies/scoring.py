@@ -167,8 +167,10 @@ def make_mase_step(model, view: ViewSpec) -> Callable:
 
 # In-memory pools up to this size stay resident on device across ALL
 # rounds and samplers (uint8, replicated like the trainer's epoch-scan
-# arrays; the per-batch gather output is what gets data-sharded).
-RESIDENT_MAX_BYTES = 2 ** 31
+# arrays; the per-batch gather output is what gets data-sharded).  The
+# single source of the default is the config module (TrainConfig's
+# resident_scoring_bytes field uses the same constant).
+from ..config import RESIDENT_SCORING_BYTES_DEFAULT as RESIDENT_MAX_BYTES
 
 
 def _resident_images(cache: Dict, dataset: Dataset, mesh):
@@ -273,8 +275,15 @@ def collect_pool(
             if keys is not None:
                 out = {k: out[k] for k in keys}
             for k, v in out.items():
-                chunks.setdefault(k, []).append(v if multi else np.asarray(v))
-        return _finalize(chunks, multi, mesh, n)
+                # Keep DEVICE arrays: a per-batch np.asarray would block on
+                # each batch and stall async dispatch (the host path hides
+                # that sync behind its threaded decode; here there is no
+                # host work to overlap).  One fetch at the end.
+                chunks.setdefault(k, []).append(v)
+        if multi:
+            return _finalize(chunks, True, mesh, n)
+        return {k: np.asarray(jnp.concatenate(v, axis=0))[:n]
+                for k, v in chunks.items()}
     # On a multi-host mesh each process gathers/decodes only its own rows
     # of every global batch; score rows come back in GLOBAL batch order
     # (mesh_lib.fetch all-gathers sharded outputs), so the global row

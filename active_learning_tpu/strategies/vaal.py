@@ -287,13 +287,10 @@ class VAALSampler(Strategy):
                      "vae_stats": self.vaal_state.vae_stats,
                      "d_params": self.vaal_state.d_params}
         loader = self.train_cfg.loader_te
-        rb = self.train_cfg.resident_scoring_bytes
         out = scoring.collect_pool(
             self.al_set, idxs, self._score_batch_size(), self._score_step,
             variables, self.mesh, num_workers=loader.num_workers,
-            prefetch=loader.prefetch,
-            resident_cache=self._resident_pool if rb else None,
-            resident_max_bytes=rb)
+            prefetch=loader.prefetch, **self._resident_kwargs())
         budget = int(min(len(idxs), budget))
         order = np.argsort(out["d_score"], kind="stable")[:budget]
         self.logger.info(f"Number of queried images: {budget}")

@@ -244,6 +244,42 @@ class TestGenJobs:
             get_strategy(cfg.strategy)  # raises if unregistered
             ARG_POOLS.get(cfg.arg_pool)
 
+    def test_cli_accepts_every_reference_flag(self):
+        """Published commands must translate flag-for-flag: the reference's
+        30 argparse flags (src/utils/parser.py:7-92, hard-coded here as the
+        stable public interface) all exist on this CLI.  The one deliberate
+        exception is --enable_comet, replaced by the JSONL metrics sink
+        (metrics on by default; --disable_metrics turns them off)."""
+        from active_learning_tpu.experiment import cli
+
+        reference_flags = [
+            # parser.py:15-21 (comet/logging)
+            "--project_name", "--exp_name", "--log_dir", "--enable_comet",
+            # parser.py:24-39 (dataset + imbalance)
+            "--dataset", "--dataset_dir", "--arg_pool", "--imbalance_type",
+            "--imbalance_factor", "--imbalance_seed",
+            # parser.py:42-54 (AL globals)
+            "--strategy", "--rounds", "--round_budget", "--freeze_feature",
+            "--init_pool_size", "--init_pool_type",
+            # parser.py:57-67 (training)
+            "--model", "--resume_training", "--exp_hash", "--ckpt_path",
+            "--n_epoch", "--early_stop_patience",
+            # parser.py:70-79 (debug + partitioning)
+            "--debug_mode", "--subset_labeled", "--subset_unlabeled",
+            "--partitions",
+            # parser.py:82-90 (VAAL)
+            "--vae_latent_dim", "--vaal_adversary_param", "--lr_vae",
+            "--lr_discriminator",
+        ]
+        assert len(reference_flags) == 30
+        parser = cli.get_parser()
+        ours = {opt for a in parser._actions for opt in a.option_strings}
+        replaced = {"--enable_comet"}  # -> --disable_metrics
+        missing = [f for f in reference_flags
+                   if f not in ours and f not in replaced]
+        assert not missing, missing
+        assert "--disable_metrics" in ours
+
     def test_vaal_adversary_flag_uses_reference_spelling(self):
         """Published VAAL commands use --vaal_adversary_param
         (reference parser.py:84); both that and the short alias must

@@ -245,3 +245,59 @@ class TestDeviceResidentEpochs:
                     rng=np.random.default_rng(0),
                     batch_hook=lambda epoch, b: seen.append(epoch))
         assert len(seen) > 0  # hook ran => host path was used
+
+
+class TestImbalancedTrainingWeights:
+    """The reference's class-weighted loss (strategy.py:444-457 +
+    CrossEntropyLoss(weight=w), strategy.py:352-356)."""
+
+    def test_class_weights_reference_semantics(self):
+        import dataclasses
+        cfg = dataclasses.replace(tiny_train_config(),
+                                  imbalanced_training=True)
+        trainer = Trainer(TinyClassifier(), cfg, mesh_lib.make_mesh(), 4)
+        labels = np.array([0, 0, 0, 1, 1, 2])  # class 3 unobserved
+        got = trainer.class_weights(labels)
+        raw = np.array([6 / 3, 6 / 2, 6 / 1, 1.0])  # total/count, else 1
+        np.testing.assert_allclose(got, raw / raw.sum(), rtol=1e-6)
+        assert abs(got.sum() - 1.0) < 1e-6
+        # Flag off: identity weights.
+        off = Trainer(TinyClassifier(), tiny_train_config(),
+                      mesh_lib.make_mesh(), 4)
+        assert (off.class_weights(labels) == 1.0).all()
+
+    def test_weighted_ce_matches_torch(self):
+        """weighted_cross_entropy == torch CrossEntropyLoss(weight=w,
+        reduction='mean'): sum(w_y * ce) / sum(w_y)."""
+        import torch
+
+        from active_learning_tpu.train.trainer import weighted_cross_entropy
+        rng = np.random.default_rng(3)
+        logits = rng.normal(size=(12, 5)).astype(np.float32)
+        labels = rng.integers(0, 5, size=12)
+        class_w = rng.uniform(0.2, 2.0, size=5).astype(np.float32)
+        ours = float(weighted_cross_entropy(
+            jnp.asarray(logits), jnp.asarray(labels),
+            jnp.asarray(class_w[labels])))
+        ref = torch.nn.CrossEntropyLoss(weight=torch.tensor(class_w))(
+            torch.tensor(logits), torch.tensor(labels))
+        assert abs(ours - float(ref)) < 1e-5
+
+    def test_zero_weight_rows_do_not_move_the_loss(self):
+        """Padding rows enter with weight 0 (mask multiplied in the train
+        step) and must be exact no-ops on the loss."""
+        from active_learning_tpu.train.trainer import weighted_cross_entropy
+        rng = np.random.default_rng(4)
+        logits = rng.normal(size=(6, 4)).astype(np.float32)
+        labels = rng.integers(0, 4, size=6)
+        w = np.ones(6, dtype=np.float32)
+        base = float(weighted_cross_entropy(
+            jnp.asarray(logits), jnp.asarray(labels), jnp.asarray(w)))
+        pad_logits = np.concatenate([logits, rng.normal(size=(3, 4))
+                                     .astype(np.float32)])
+        pad_labels = np.concatenate([labels, np.array([0, 1, 2])])
+        pad_w = np.concatenate([w, np.zeros(3, np.float32)])
+        padded = float(weighted_cross_entropy(
+            jnp.asarray(pad_logits), jnp.asarray(pad_labels),
+            jnp.asarray(pad_w)))
+        assert abs(base - padded) < 1e-6
