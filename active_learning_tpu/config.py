@@ -111,11 +111,14 @@ class TrainConfig:
     # cache_eval_bytes, falling back to per-epoch decode past the budget.
     cache_eval: bool = True
     cache_eval_bytes: int = 4 << 30
-    # Keep in-memory pool images resident on device (replicated) for ALL
-    # rounds' acquisition scoring when they fit under this size — one
-    # upload per experiment instead of one per scoring pass.  0 disables;
-    # lower it on small-HBM chips where a ~2 GiB pinned pool could crowd
-    # out later-round training.
+    # Keep in-memory datasets resident on device (replicated) for the
+    # whole experiment — ONE shared upload serves every round's
+    # acquisition scoring AND the per-epoch validation/test evaluation
+    # (parallel/resident.py).  The budget applies per underlying image
+    # array that fits under it (the AL pool and the test set are separate
+    # arrays, so each may pin up to this size).  0 disables both resident
+    # paths; lower it on small-HBM chips where pinned pools could crowd
+    # out training.
     resident_scoring_bytes: int = RESIDENT_SCORING_BYTES_DEFAULT
 
     @property

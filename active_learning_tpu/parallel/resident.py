@@ -1,0 +1,89 @@
+"""Device-resident pools: in-memory dataset rows uploaded once per
+experiment and gathered ON DEVICE per batch.
+
+One cache serves every consumer — acquisition scoring
+(strategies/scoring.py) and evaluation (train/trainer.py) — so a pool
+whose views share storage (ArrayDataset.with_view) is uploaded exactly
+once, and the ``resident_scoring_bytes`` budget means what it says per
+underlying array.  Entries retain their dataset object: keys include
+id()s, and without the reference a recycled id could silently alias
+another pool's images.
+
+Layout of a cache dict:
+  cache["images"][(id(images), n)] = (dataset, images_dev, labels_dev)
+  cache["steps"][(id(step_fn), with_labels)] = jitted runner
+
+Virtual-CPU-mesh caveat: the N replicas' on-device gathers execute
+serially on one core there, so resident paths can measure slower on the
+test mesh; on real chips the replicas are parallel and the gather
+replaces a host->device transfer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import numpy as np
+
+from . import mesh as mesh_lib
+
+
+def eligible(dataset: Any, max_bytes: int) -> bool:
+    """In-memory (ArrayDataset-style) and within the byte budget."""
+    images = getattr(dataset, "images", None)
+    return (max_bytes > 0 and isinstance(images, np.ndarray)
+            and images[: len(dataset)].nbytes <= max_bytes)
+
+
+def pool_arrays(cache: Dict, dataset: Any, mesh) -> Tuple[Any, Any]:
+    """(images_dev, labels_dev) for the dataset, uploaded once per
+    (underlying array, length) — views sharing storage share the upload.
+    replicate() device_puts EXPLICITLY (transfer-guard friendly)."""
+    images = cache.setdefault("images", {})
+    n = len(dataset)
+    key = (id(dataset.images), n)
+    if key not in images:
+        images[key] = (
+            dataset,
+            mesh_lib.replicate(
+                np.ascontiguousarray(dataset.images[:n]), mesh),
+            mesh_lib.replicate(
+                dataset.targets[:n].astype(np.int32), mesh))
+    return images[key][1], images[key][2]
+
+
+def get_runner(cache: Dict, step_fn: Callable, mesh,
+               with_labels: bool = False) -> Callable:
+    """Jitted gather+step over a resident pool: rows are picked out on
+    device and constrained to the batch sharding, so each batch costs one
+    tiny [batch]-int32 transfer instead of the image rows."""
+    steps = cache.setdefault("steps", {})
+    key = (id(step_fn), with_labels)
+    if key not in steps:
+        batch_sharding = mesh_lib.batch_sharding(mesh)
+
+        if with_labels:
+
+            @jax.jit
+            def run(variables, images, labels, ids, mask):
+                batch = {
+                    "image": jax.lax.with_sharding_constraint(
+                        images[ids], batch_sharding),
+                    "label": labels[ids],
+                    "mask": mask,
+                }
+                return step_fn(variables, batch)
+        else:
+
+            @jax.jit
+            def run(variables, images, ids, mask):
+                batch = {
+                    "image": jax.lax.with_sharding_constraint(
+                        images[ids], batch_sharding),
+                    "mask": mask,
+                }
+                return step_fn(variables, batch)
+
+        steps[key] = run
+    return steps[key]

@@ -80,10 +80,11 @@ class Strategy:
         self.mesh = trainer.mesh
         self.state: Optional[TrainState] = None
         self.best_epoch: int = 0
-        # Device-resident scoring pool: in-memory pool images live on
-        # device for the WHOLE experiment (scoring.collect_pool fast
-        # path); one upload serves every round's every sampler.
-        self._resident_pool: Dict = {}
+        # Device-resident pool cache: in-memory pool images live on device
+        # for the WHOLE experiment (scoring.collect_pool fast path).  It
+        # is the TRAINER'S cache, shared with evaluation, so one upload
+        # serves every round's every sampler AND the per-epoch validation.
+        self._resident_pool: Dict = trainer.resident_pool
         # True only for the first train() after a genuine experiment
         # resume (the driver sets it): that is the one fit allowed to
         # consume a mid-round fit state from disk; trainer.fit discards

@@ -169,51 +169,11 @@ def make_mase_step(model, view: ViewSpec) -> Callable:
 # rounds and samplers (uint8, replicated like the trainer's epoch-scan
 # arrays; the per-batch gather output is what gets data-sharded).  The
 # single source of the default is the config module (TrainConfig's
-# resident_scoring_bytes field uses the same constant).
+# resident_scoring_bytes field uses the same constant); the shared pool
+# cache + jitted gather-runners live in parallel/resident.py so scoring
+# and evaluation upload each pool exactly once between them.
 from ..config import RESIDENT_SCORING_BYTES_DEFAULT as RESIDENT_MAX_BYTES
-
-
-def _resident_images(cache: Dict, dataset: Dataset, mesh):
-    """The pool's images, uploaded ONCE per (dataset, experiment) — the
-    images never change across AL rounds, only the labeled mask does, so
-    re-uploading them for every round's every scoring pass (as the host
-    path must) is pure waste.
-
-    The cache entry RETAINS the dataset object alongside the device
-    array: keys are id(dataset), and without the reference a
-    garbage-collected short-lived wrapper could hand its id to a new
-    dataset that would then silently score the wrong images."""
-    images = cache.setdefault("images", {})
-    key = id(dataset)
-    if key not in images:
-        n = len(dataset)
-        # replicate() device_puts EXPLICITLY (transfer-guard friendly).
-        images[key] = (dataset, mesh_lib.replicate(
-            np.ascontiguousarray(dataset.images[:n]), mesh))
-    return images[key][1]
-
-
-def _resident_runner(cache: Dict, step_fn: Callable, mesh):
-    """Jitted gather+score: rows are picked out of the resident pool ON
-    DEVICE and constrained to the batch sharding, so each scoring batch
-    costs one tiny [batch]-int32 transfer instead of the full image
-    rows."""
-    steps = cache.setdefault("steps", {})
-    key = id(step_fn)
-    if key not in steps:
-        batch_sharding = mesh_lib.batch_sharding(mesh)
-
-        @jax.jit
-        def run(variables, images, ids, mask):
-            batch = {
-                "image": jax.lax.with_sharding_constraint(
-                    images[ids], batch_sharding),
-                "mask": mask,
-            }
-            return step_fn(variables, batch)
-
-        steps[key] = run
-    return steps[key]
+from ..parallel import resident as resident_lib
 
 
 def _finalize(chunks: Dict[str, list], multi: bool, mesh, n: int
@@ -262,10 +222,10 @@ def collect_pool(
     # every round's every sampler is an on-device gather — zero image
     # bytes cross the host<->device boundary after the first round.
     if (resident_cache is not None
-            and isinstance(getattr(dataset, "images", None), np.ndarray)
-            and dataset.images[:len(dataset)].nbytes <= resident_max_bytes):
-        images_dev = _resident_images(resident_cache, dataset, mesh)
-        run = _resident_runner(resident_cache, step_fn, mesh)
+            and resident_lib.eligible(dataset, resident_max_bytes)):
+        images_dev, _ = resident_lib.pool_arrays(resident_cache, dataset,
+                                                 mesh)
+        run = resident_lib.get_runner(resident_cache, step_fn, mesh)
         multi = mesh_lib.is_multiprocess(mesh)
         chunks: Dict[str, list] = {}
         for b in batch_index_lists(idxs, batch_size):

@@ -35,7 +35,8 @@ from flax import serialization, struct
 from ..config import TrainConfig
 from ..data.augment import apply_view
 from ..data.core import Dataset
-from ..data.pipeline import iterate_batches, num_batches
+from ..data.pipeline import (batch_index_lists, iterate_batches,
+                             num_batches, padded_batch_layout)
 from ..parallel import mesh as mesh_lib
 from ..utils.logging import get_logger
 from . import checkpoint as ckpt_lib
@@ -101,6 +102,12 @@ class Trainer:
         self._train_step = self._build_train_step()
         self._epoch_scan: Optional[Callable] = None  # built on first use
         self._eval_steps: Dict[Any, Callable] = {}
+        # ONE device-resident pool cache for the whole experiment, shared
+        # between evaluation (here) and acquisition scoring (the Strategy
+        # passes it into collect_pool): pools keyed by their UNDERLYING
+        # images array, so al/train views sharing storage upload once and
+        # the resident_scoring_bytes budget is per-array, not per-consumer.
+        self.resident_pool: Dict[Any, Any] = {}
 
     # -- setup -----------------------------------------------------------
 
@@ -296,6 +303,28 @@ class Trainer:
         eval_step = self._get_eval_step(dataset.view)
         bs = self.padded_batch_size(self.cfg.loader_te.batch_size)
         variables = state.variables
+
+        from ..parallel import resident as resident_lib
+        if resident_lib.eligible(dataset, self.cfg.resident_scoring_bytes):
+            # Device-resident path: on-device row gather per batch, count
+            # totals accumulated ON DEVICE (one host fetch at the end) so
+            # async dispatch pipelines the whole eval pass; see
+            # parallel/resident.py for the shared cache and the
+            # virtual-CPU-mesh caveat.  resident_scoring_bytes=0 disables.
+            images_dev, labels_dev = resident_lib.pool_arrays(
+                self.resident_pool, dataset, self.mesh)
+            run = resident_lib.get_runner(self.resident_pool, eval_step,
+                                          self.mesh, with_labels=True)
+            totals = None
+            for b in batch_index_lists(np.asarray(idxs), bs):
+                ids, mask = padded_batch_layout(b, bs)
+                small = mesh_lib.replicate((ids.astype(np.int32), mask),
+                                           self.mesh)
+                counts = run(variables, images_dev, labels_dev, *small)
+                totals = (counts if totals is None
+                          else jax.tree.map(jnp.add, totals, counts))
+            return accumulate_metrics(iter(() if totals is None
+                                           else (totals,)))
 
         local = mesh_lib.process_local_rows(self.mesh, bs)
 

@@ -49,6 +49,15 @@ class TestResidentScoring:
         assert calls["n"] == 0  # zero host image gathers across rounds
         assert len(s._resident_pool["images"]) == 1  # one upload total
 
+    def test_scoring_and_evaluation_share_one_upload(self):
+        """The trainer's evaluation and the sampler's scoring draw from
+        ONE shared cache: the pool uploads once for both consumers."""
+        s = make_strategy("MarginSampler", n_train=96)
+        s.query(4)  # scoring uploads the pool
+        s.trainer.evaluate(s.state, s.al_set, np.arange(8))  # reuses it
+        assert len(s._resident_pool["images"]) == 1
+        assert s._resident_pool is s.trainer.resident_pool
+
     def test_zero_budget_disables_resident_path(self):
         """resident_scoring_bytes=0 must fall back to host-batched scoring
         (no upload, host gathers happen)."""

@@ -301,3 +301,54 @@ class TestImbalancedTrainingWeights:
             jnp.asarray(pad_logits), jnp.asarray(pad_labels),
             jnp.asarray(pad_w)))
         assert abs(base - padded) < 1e-6
+
+
+class TestResidentEvaluation:
+    """In-memory eval/test rows stay device-resident across epochs and
+    rounds; results must be identical to the host-batched path."""
+
+    def test_matches_host_batched_evaluate(self):
+        import dataclasses
+        train_set, _, al_set = get_data_synthetic(
+            n_train=100, n_test=16, num_classes=4, image_size=8, seed=9)
+        mesh = mesh_lib.make_mesh()
+        res = Trainer(BNClassifier(), tiny_train_config(), mesh, 4,
+                      train_bn=True)
+        host = Trainer(BNClassifier(),
+                       dataclasses.replace(tiny_train_config(),
+                                           resident_scoring_bytes=0),
+                       mesh, 4, train_bn=True)
+        state = res.init_state(jax.random.PRNGKey(1),
+                               train_set.gather(np.arange(2)))
+        idxs = np.arange(37, 100)  # padded last batch included
+        a = res.evaluate(state, al_set, idxs)
+        b = host.evaluate(state, al_set, idxs)
+        assert len(res.resident_pool["images"]) == 1
+        for k in a:
+            np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                       rtol=1e-6, atol=1e-6, err_msg=k)
+
+    def test_views_share_one_upload_and_no_host_gathers(self):
+        """al/train views share storage -> one upload; repeated evaluate
+        calls (per-epoch validation) never touch the host dataset again."""
+        train_set, _, al_set = get_data_synthetic(
+            n_train=64, n_test=16, num_classes=4, image_size=8, seed=9)
+        mesh = mesh_lib.make_mesh()
+        trainer = Trainer(BNClassifier(), tiny_train_config(), mesh, 4,
+                          train_bn=True)
+        state = trainer.init_state(jax.random.PRNGKey(1),
+                                   train_set.gather(np.arange(2)))
+        calls = {"n": 0}
+        orig = al_set.gather
+
+        def counting(idxs):
+            calls["n"] += 1
+            return orig(idxs)
+
+        al_set.gather = counting
+        for _ in range(3):  # three "epochs" of validation
+            trainer.evaluate(state, al_set, np.arange(48, 64))
+        trainer.evaluate(state, train_set.with_view(al_set.view),
+                         np.arange(8))  # shares the images array
+        assert calls["n"] == 0
+        assert len(trainer.resident_pool["images"]) == 1  # one upload for both
