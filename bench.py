@@ -197,6 +197,26 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
         rows += int(b["mask"].sum())
     decode_ips = rows / (time.perf_counter() - t0)
 
+    result = {
+        "phase": "imagenet_datapath",
+        "n_chips": n_chips,
+        "batch_per_chip": per_chip,
+        "n_images": len(dataset),
+        "decode_ips": round(decode_ips, 1),
+        "host_cores": cores,
+        "decode_ips_per_core": round(decode_ips / cores, 1),
+        "gen_sec": round(gen_sec, 1),
+        "device_kind": device_kind,
+        "platform": jax.devices()[0].platform,
+    }
+    if os.environ.get("AL_BENCH_DATAPATH_DECODE_ONLY") == "1":
+        # Accelerator unreachable: report the host-side numbers (the
+        # phase's real subject) and skip the model pass.
+        result.update(ips=round(decode_ips, 1),
+                      ips_per_chip=round(decode_ips / n_chips, 1),
+                      decode_only=True)
+        return result
+
     # Full scoring pass over the whole tree, decode overlapped with device
     # compute exactly as a real acquisition round runs it.
     model, _, _, _, score_view = _model_and_views("resnet50_imagenet")
@@ -218,21 +238,9 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
     score_sec = time.perf_counter() - t0
     assert len(out["margin"]) == len(dataset)
     ips = len(dataset) / score_sec
-    return {
-        "phase": "imagenet_datapath",
-        "ips": round(ips, 1),
-        "ips_per_chip": round(ips / n_chips, 1),
-        "n_chips": n_chips,
-        "batch_per_chip": per_chip,
-        "n_images": len(dataset),
-        "decode_ips": round(decode_ips, 1),
-        "host_cores": cores,
-        "decode_ips_per_core": round(decode_ips / cores, 1),
-        "gen_sec": round(gen_sec, 1),
-        "score_sec": round(score_sec, 1),
-        "device_kind": device_kind,
-        "platform": jax.devices()[0].platform,
-    }
+    result.update(ips=round(ips, 1), ips_per_chip=round(ips / n_chips, 1),
+                  score_sec=round(score_sec, 1))
+    return result
 
 
 def _flops_per_step(jitted, phase: str, *args, **kwargs):
@@ -389,9 +397,15 @@ def _parse_child_json(stdout: str):
 def run_phase_with_retries(name: str, iters: int, per_chip: int,
                            timeout: float, deadline: float):
     """Up to 3 attempts; iters halve per retry, batch halves on OOM.
+    The datapath phase gets a 4th attempt on the CPU backend: its
+    headline metrics (decode imgs/sec, per-core rate) are host-side, so a
+    dead accelerator tunnel must not erase them — the result is tagged
+    with platform "cpu" by the child itself.
     Returns (result dict | None, failure string | None)."""
     failure = None
-    for attempt in range(3):
+    attempts = 4 if name == "imagenet_datapath" else 3
+    for attempt in range(attempts):
+        cpu_fallback = name == "imagenet_datapath" and attempt == attempts - 1
         remaining = deadline - time.monotonic()
         if remaining <= 30:
             return None, failure or "wall-clock budget exhausted"
@@ -399,11 +413,21 @@ def run_phase_with_retries(name: str, iters: int, per_chip: int,
                               remaining)
         cmd = [sys.executable, os.path.abspath(__file__), "--phase", name,
                "--iters", str(iters), "--per-chip-batch", str(per_chip)]
+        env = None
+        if cpu_fallback:
+            # Decode-only: the ResNet-50 scoring pass is pointless on one
+            # CPU core and would blow the timeout; the host-side decode
+            # rate is the number this fallback exists to save.
+            env = dict(os.environ, PALLAS_AXON_POOL_IPS="",
+                       JAX_PLATFORMS="cpu",
+                       AL_BENCH_DATAPATH_DECODE_ONLY="1")
+            log(f"[parent] {name}: accelerator attempts failed; measuring "
+                "the host-side data path (decode only) on the CPU backend")
         log(f"[parent] {name} attempt {attempt + 1}: iters={iters} "
             f"batch/chip={per_chip} timeout={attempt_timeout:.0f}s")
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=attempt_timeout)
+                                  timeout=attempt_timeout, env=env)
         except subprocess.TimeoutExpired as e:
             partial = e.stderr or ""
             if isinstance(partial, bytes):
@@ -496,8 +520,12 @@ def _main_inner() -> None:
             result["captured_utc"] = time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
             phases[name] = result
-            cache[name] = result
-            _save_cache(cache)
+            if not result.get("decode_only"):
+                # A decode-only CPU fallback is a degraded capture; it
+                # must never clobber a real accelerator entry in the
+                # cache (the cache exists to preserve those).
+                cache[name] = result
+                _save_cache(cache)
             log(f"[parent] {name}: {result['ips']:,.0f} img/s total, "
                 f"{result['ips_per_chip']:,.0f} img/s/chip")
         else:
@@ -532,7 +560,9 @@ def _main_inner() -> None:
     for name in ("resnet50_imagenet_train", "resnet18_cifar_train",
                  "resnet50_imagenet_score", "resnet18_cifar_score",
                  "imagenet_datapath"):
-        if name in phases:
+        # A decode-only datapath result is a host decode rate, not model
+        # throughput — never the headline.
+        if name in phases and not phases[name].get("decode_only"):
             headline = name
             break
 
