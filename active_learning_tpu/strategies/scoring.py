@@ -20,7 +20,7 @@ scores.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterable, Optional
+from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -119,8 +119,23 @@ def make_badge_step(model, view: ViewSpec, pool_512: bool = False
     return step
 
 
+@jax.jit
+def head_pair_norms(kernel: jnp.ndarray) -> jnp.ndarray:
+    """[C, C] table of ||w_c - w_j|| over the head rows, by explicit row
+    differences (peak live [C, D]).  Batch-independent: callers that score
+    many batches against one head compute this once per head (see
+    make_mase_step) — NOT via the Gram identity G_cc + G_jj - 2 G_cj,
+    whose float32 cancellation would misreport near-duplicate head columns
+    as coincident (denominator 0 -> radius +inf)."""
+    w = kernel.T.astype(jnp.float32)  # [C, D]
+    return jax.lax.map(
+        lambda wc: jnp.linalg.norm(w - wc[None, :], axis=-1), w)
+
+
 def boundary_radii(embedding: jnp.ndarray, kernel: jnp.ndarray,
-                   bias: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+                   bias: jnp.ndarray,
+                   pair_norms: Optional[jnp.ndarray] = None
+                   ) -> Dict[str, jnp.ndarray]:
     """Closed-form distance from each embedding to every one-vs-one decision
     boundary of the linear head (MASE, mase_sampler.py:59-79).
 
@@ -129,16 +144,25 @@ def boundary_radii(embedding: jnp.ndarray, kernel: jnp.ndarray,
     ((w_c - w_j)·e + b_c - b_j) / ||w_c - w_j||.  The j == c entry is 0/0
     and mapped to +inf, matching the reference's nan -> inf fix-up.
 
+    Both terms collapse algebraically so no [B, C, D] tensor ever exists
+    (the reference materializes one per batch, mase_sampler.py:62-70 —
+    2 GB at B=256, C=1000, D=2048):
+
+      numerator   (w_c - w_j)·e + (b_c - b_j)  ==  logit_c - logit_j,
+                  already computed by the forward pass;
+      denominator ||w_c - w_j||, the batch-independent ``head_pair_norms``
+                  table — pass it as ``pair_norms`` when scoring many
+                  batches against one head so the C-step map runs once per
+                  head, not once per batch.
+
     kernel is the Flax Dense kernel [D, C]; bias [C].
     """
-    logits = embedding @ kernel + bias  # [B, C]
+    logits = (embedding @ kernel + bias).astype(jnp.float32)  # [B, C]
     preds = jnp.argmax(logits, axis=-1)  # [B]
-    w = kernel.T  # [C, D]
-    w_pred = w[preds]  # [B, D]
-    delta_w = w_pred[:, None, :] - w[None, :, :]  # [B, C, D]
-    delta_b = bias[preds][:, None] - bias[None, :]  # [B, C]
-    numer = jnp.einsum("bd,bcd->bc", embedding, delta_w) + delta_b
-    denom = jnp.linalg.norm(delta_w, axis=-1)  # [B, C]
+    if pair_norms is None:
+        pair_norms = head_pair_norms(kernel)  # [C, C]
+    denom = pair_norms[preds]  # [B, C]
+    numer = jnp.take_along_axis(logits, preds[:, None], axis=1) - logits
     radii = jnp.where(denom > 0, numer / jnp.maximum(denom, 1e-30), jnp.inf)
     return {"radii": radii, "pred": preds.astype(jnp.int32)}
 
@@ -147,20 +171,41 @@ def make_mase_step(model, view: ViewSpec) -> Callable:
     """Per-class boundary radii + min margin, fully on device.
 
     The reference materializes [B, C, D] tensors per batch on GPU
-    (mase_sampler.py:62-79); the einsum here contracts D immediately so the
-    peak live tensor is [B, C, D] only inside the fused XLA computation.
+    (mase_sampler.py:62-79); ``boundary_radii`` reduces both terms
+    algebraically so the largest intermediate is [C, D], and the
+    batch-independent pair-norm table is computed once per HEAD (a pool
+    scan runs thousands of batches against one set of weights) via a
+    one-slot cache keyed on the kernel array's identity.
     """
+    cache: Dict[str, Any] = {}
 
     @jax.jit
-    def step(variables, batch):
+    def jitted_step(variables, batch, pair_norms):
         x = apply_view(batch["image"], view, train=False)
         _, embedding = model.apply(variables, x, train=False,
                                    return_features=True)
         kernel = variables["params"]["linear"]["kernel"]
         bias = variables["params"]["linear"]["bias"]
-        out = boundary_radii(embedding, kernel, bias)
+        out = boundary_radii(embedding, kernel, bias, pair_norms=pair_norms)
         out["min_margin"] = jnp.min(out["radii"], axis=-1)
         return out
+
+    def step(variables, batch):
+        kernel = variables["params"]["linear"]["kernel"]
+        if isinstance(kernel, jax.core.Tracer):
+            # Called under someone else's trace (the resident-pool gather
+            # runner, parallel/resident.py): a host-side cache can't help
+            # there, so inline the norms into that computation.  Resident
+            # pools are in-memory/CIFAR-scale, where the C-step map is
+            # trivial; the C=1000 disk datasets always take the host path
+            # below.
+            return jitted_step(variables, batch, None)
+        # Identity (not equality) check; holding the reference keeps the
+        # id from being reused by a different array.
+        if cache.get("kernel") is not kernel:
+            cache["kernel"] = kernel
+            cache["norms"] = head_pair_norms(kernel)
+        return jitted_step(variables, batch, cache["norms"])
 
     return step
 

@@ -243,6 +243,72 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
     return result
 
 
+def _phase_setup(config: str, batch_size: int):
+    """Shared model/trainer/batch construction for the timing child and
+    the CPU FLOPs child: the batch schema and step signatures live in ONE
+    place so the two paths cannot drift.  ``batch_size`` is the GLOBAL
+    batch over the current backend's mesh."""
+    import numpy as np
+
+    import jax
+    from active_learning_tpu.config import LoaderConfig, TrainConfig
+    from active_learning_tpu.parallel import mesh as mesh_lib
+    from active_learning_tpu.train.trainer import Trainer
+
+    mesh = mesh_lib.make_mesh(-1)
+    model, px, n_classes, train_view, score_view = _model_and_views(config)
+    cfg = TrainConfig(loader_tr=LoaderConfig(batch_size=batch_size))
+    trainer = Trainer(model, cfg, mesh, num_classes=n_classes, train_bn=True)
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "image": rng.integers(0, 256, size=(batch_size, px, px, 3),
+                              dtype=np.uint8),
+        "label": rng.integers(0, n_classes,
+                              size=batch_size).astype(np.int32),
+        "index": np.arange(batch_size, dtype=np.int32),
+        "mask": np.ones(batch_size, dtype=np.float32),
+    }
+    batch = mesh_lib.shard_batch(host_batch, mesh)
+    state = trainer.init_state(jax.random.PRNGKey(0),
+                               host_batch["image"][:min(8, batch_size)])
+    return (mesh, model, n_classes, train_view, score_view, trainer, batch,
+            state)
+
+
+def run_flops_cpu(phase: str, batch_size: int) -> dict:
+    """Per-image FLOPs of a phase's step, lowered on the CPU backend.
+
+    The tunneled TPU backend does not expose ``cost_analysis`` reliably,
+    but the FLOP count is a property of the computation, not the device —
+    lowering the identical step on CPU (run with JAX_PLATFORMS=cpu) gives
+    the same number, and the parent combines it with the TPU-measured
+    images/sec to report achieved TFLOP/s and MFU."""
+    import jax
+    import jax.numpy as jnp
+
+    config, kind = phase.rsplit("_", 1)
+    (mesh, model, n_classes, train_view, score_view, trainer, batch,
+     state) = _phase_setup(config, batch_size)
+    if kind == "train":
+        flops = _flops_per_step(
+            trainer._train_step, phase, state, batch, jax.random.PRNGKey(1),
+            jnp.float32(0.1), jnp.ones(n_classes, jnp.float32),
+            view=train_view)
+    else:
+        from active_learning_tpu.strategies import scoring
+        sstep = scoring.make_prob_stats_step(model, score_view)
+        flops = _flops_per_step(sstep, phase,
+                                state.variables,
+                                {"image": batch["image"],
+                                 "mask": batch["mask"]})
+    n_local = int(mesh.devices.size)
+    return {"phase": phase, "flops_source": "cpu-lowering",
+            # cost_analysis reports the per-device partitioned module, so
+            # divide by the rows one device saw.
+            "flops_per_image": (flops * n_local / batch_size
+                                if flops else None)}
+
+
 def _flops_per_step(jitted, phase: str, *args, **kwargs):
     """Per-device flops of one step via AOT lower/compile.  This is a
     SECOND full XLA compile (it does not reuse the jit cache), so callers
@@ -264,40 +330,21 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
     same result enriched with flops/MFU.  The caller prints each as its
     own JSON line and the parent keeps the LAST parseable one, so the
     enrichment compile is strictly best-effort."""
-    import numpy as np
-
     import jax
     import jax.numpy as jnp
-    from active_learning_tpu.config import LoaderConfig, TrainConfig
-    from active_learning_tpu.parallel import mesh as mesh_lib
-    from active_learning_tpu.train.trainer import Trainer
 
     if phase == "imagenet_datapath":
         yield run_datapath_phase(iters * 1000, per_chip)
         return
     config, kind = phase.rsplit("_", 1)
-    mesh = mesh_lib.make_mesh(-1)
-    n_chips = int(mesh.devices.size)
+    n_chips = len(jax.devices())
     batch_size = per_chip * n_chips
     device_kind = jax.devices()[0].device_kind
     log(f"[{phase}] {n_chips}x {device_kind}, batch {batch_size} "
         f"({per_chip}/chip), {iters} iters")
 
-    model, px, n_classes, train_view, score_view = _model_and_views(config)
-    cfg = TrainConfig(loader_tr=LoaderConfig(batch_size=batch_size))
-    trainer = Trainer(model, cfg, mesh, num_classes=n_classes, train_bn=True)
-
-    rng = np.random.default_rng(0)
-    host_batch = {
-        "image": rng.integers(0, 256, size=(batch_size, px, px, 3),
-                              dtype=np.uint8),
-        "label": rng.integers(0, n_classes, size=batch_size).astype(np.int32),
-        "index": np.arange(batch_size, dtype=np.int32),
-        "mask": np.ones(batch_size, dtype=np.float32),
-    }
-    batch = mesh_lib.shard_batch(host_batch, mesh)
-    state = trainer.init_state(jax.random.PRNGKey(0),
-                               host_batch["image"][:min(8, batch_size)])
+    (mesh, model, n_classes, train_view, score_view, trainer, batch,
+     state) = _phase_setup(config, batch_size)
 
     if kind == "train":
         class_weights = jnp.ones(n_classes, jnp.float32)
@@ -328,18 +375,24 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         sbatch = {"image": batch["image"], "mask": batch["mask"]}
         sstep = scoring.make_prob_stats_step(model, score_view)
         variables = state.variables
-        out = None
-        for _ in range(3):
-            out = sstep(variables, sbatch)
-        float(out["margin"][0])
-        # Chain a scalar through every iteration so the final host fetch
-        # is data-dependent on ALL of them (independent dead outputs could
-        # otherwise be skipped/in-flight when the fetch returns).
-        t0 = time.perf_counter()
+
+        # Chain a scalar through every iteration INSIDE one jitted call so
+        # the final host fetch is data-dependent on all of them, with
+        # exactly one dispatch per iteration — per-iteration eager ops
+        # (indexing + add) each cost a full round-trip on a tunneled
+        # remote backend and can dwarf the compute being measured.
+        @jax.jit
+        def chained(variables, batch, carry):
+            out = sstep(variables, batch)
+            return carry + out["margin"][0]
+
         carry = jnp.float32(0.0)
+        for _ in range(3):
+            carry = chained(variables, sbatch, carry)
+        float(carry)
+        t0 = time.perf_counter()
         for _ in range(iters):
-            out = sstep(variables, sbatch)
-            carry = carry + out["margin"][0]
+            carry = chained(variables, sbatch, carry)
         float(carry)
         dt = time.perf_counter() - t0
 
@@ -378,7 +431,10 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
 # Parent: orchestrate phases in subprocesses; always print one JSON line.
 # ---------------------------------------------------------------------------
 
-def _parse_child_json(stdout: str):
+def _parse_child_json(stdout: str, required=("ips", "ips_per_chip")):
+    """Last stdout line that parses as a dict carrying all ``required``
+    keys — stray JSON-ish lines from libraries must not masquerade as a
+    phase result."""
     for line in reversed(stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -386,10 +442,8 @@ def _parse_child_json(stdout: str):
                 result = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            # Only accept a real phase result — stray JSON-ish lines from
-            # libraries must not masquerade as one.
-            if isinstance(result, dict) and "ips" in result \
-                    and "ips_per_chip" in result:
+            if isinstance(result, dict) and all(k in result
+                                                for k in required):
                 return result
     return None
 
@@ -433,6 +487,17 @@ def run_phase_with_retries(name: str, iters: int, per_chip: int,
             if isinstance(partial, bytes):
                 partial = partial.decode(errors="replace")
             sys.stderr.write(partial[-2000:])
+            # The child prints each completed measurement as its own line
+            # BEFORE the optional flops-enrichment compile — a timeout
+            # inside the enrichment must not discard a finished number.
+            out = e.stdout or ""
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            result = _parse_child_json(out)
+            if result is not None:
+                log(f"[parent] {name}: timed out during enrichment; "
+                    "keeping the completed measurement")
+                return result, None
             failure = f"timeout after {attempt_timeout:.0f}s"
             log(f"[parent] {name}: {failure}")
             if "RESOURCE_EXHAUSTED" in partial:
@@ -555,6 +620,52 @@ def _main_inner() -> None:
             log(f"[parent] {name}: fresh capture failed; using cached "
                 f"result from {entry.get('captured_utc')}")
 
+    # MFU back-fill: cost_analysis is unavailable on the tunneled TPU
+    # backend, so phases that timed or errored out of the on-device flops
+    # enrichment get their FLOP count from an identical CPU lowering (a
+    # property of the computation, not the device) combined with the
+    # TPU-measured throughput.
+    for name, entry in phases.items():
+        if name == "imagenet_datapath" or entry.get("mfu") \
+                or not entry.get("ips_per_chip"):
+            continue
+        remaining = deadline - time.monotonic()
+        if remaining <= 60:
+            break
+        # FLOPs scale linearly in batch, so lower a small batch (cheap CPU
+        # compile) and let the child normalize per image.
+        cmd = [sys.executable, os.path.abspath(__file__), "--phase", name,
+               "--flops-cpu", "--per-chip-batch",
+               str(min(32, entry.get("batch_per_chip", 128)))]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        log(f"[parent] {name}: computing FLOPs via CPU lowering")
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=min(600, remaining), env=env)
+        except subprocess.SubprocessError as e:
+            log(f"[parent] {name}: flops child failed: {e!r}")
+            continue
+        parsed = _parse_child_json(proc.stdout,
+                                   required=("flops_per_image",))
+        flops = (parsed or {}).get("flops_per_image")
+        if not flops:
+            log(f"[parent] {name}: CPU flops lowering gave nothing "
+                f"(rc={proc.returncode})")
+            continue
+        tflops_chip = flops * entry["ips_per_chip"] / 1e12
+        entry["gflop_per_image"] = round(flops / 1e9, 2)
+        entry["tflops_per_sec_per_chip"] = round(tflops_chip, 1)
+        entry["flops_source"] = "cpu-lowering"
+        peak = _peak_tflops(entry.get("device_kind", ""))
+        if peak:
+            entry["mfu"] = round(tflops_chip / peak, 3)
+            entry["peak_tflops_per_chip"] = peak
+        if name in cache and not entry.get("decode_only"):
+            cache[name] = {k: v for k, v in entry.items()
+                           if k not in ("cached", "fresh_failure",
+                                        "device_unverified")}
+            _save_cache(cache)
+
     # Headline: the north-star model if captured, else the CIFAR model.
     headline = None
     for name in ("resnet50_imagenet_train", "resnet18_cifar_train",
@@ -591,8 +702,12 @@ if __name__ == "__main__":
     parser.add_argument("--phase", default=None)
     parser.add_argument("--iters", type=int, default=50)
     parser.add_argument("--per-chip-batch", type=int, default=128)
+    parser.add_argument("--flops-cpu", action="store_true")
     args = parser.parse_args()
-    if args.phase:
+    if args.phase and args.flops_cpu:
+        print(json.dumps(run_flops_cpu(args.phase, args.per_chip_batch)),
+              flush=True)
+    elif args.phase:
         for result in run_child_phase(args.phase, args.iters,
                                       args.per_chip_batch):
             print(json.dumps(result), flush=True)
