@@ -61,13 +61,18 @@ def accumulate_metrics(count_iter: Iterator[Dict[str, jnp.ndarray]]
     """Sum per-batch counts and derive the reference's metric dict keys
     (evaluation.py:58-66): accuracy, top_5_accuracy, accuracy_byclass,
     corrects_byclass, count_byclass, count."""
-    totals: Optional[Dict[str, np.ndarray]] = None
+    # Accumulate WITHOUT fetching: summing device arrays dispatches a tiny
+    # async add per batch, and the single np.asarray at the end is the only
+    # host round-trip — a per-batch fetch would serialize the eval pipeline
+    # on a remote/tunneled runtime.
+    totals = None
     for counts in count_iter:
-        counts = {k: np.asarray(v) for k, v in counts.items()}
         if totals is None:
-            totals = counts
+            totals = dict(counts)
         else:
             totals = {k: totals[k] + counts[k] for k in totals}
+    if totals is not None:
+        totals = {k: np.asarray(v) for k, v in totals.items()}
     if totals is None:
         # Empty eval set (eval_split=0): report zero accuracy instead of
         # crashing mid-fit; callers treat 0 as "no signal".

@@ -73,6 +73,11 @@ PHASES = [
     # THOUSANDS of images so the retry halving shrinks the tree.
     ("imagenet_datapath", 50, 128, 900),
     ("resnet18_cifar_score", 30, 256, 420),
+    # The selection hot loop (SURVEY hard part (a)): greedy k-center over
+    # a 50k-row, 2048-dim pool — the reference's paper protocol subsets
+    # the pool to 50k and picks 10k per round (gen_jobs.py:8-13).  iters
+    # is the budget (picks); per-chip batch is unused.
+    ("kcenter_select", 10000, 128, 600),
 ]
 TOTAL_BUDGET_S = 3000.0  # stop launching attempts past this wall-clock
 
@@ -209,6 +214,21 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
     }
+    if jax.devices()[0].platform != "cpu":
+        # Host->device bandwidth for one decoded batch: on a tunneled
+        # remote backend this transfer (19 MB per 128-row 224px batch) can
+        # be the end-to-end bottleneck; on a co-located TPU host it is
+        # PCIe-speed noise.  Reported so a slow end-to-end rate is
+        # attributable.  Skipped on the CPU-fallback backend, where a
+        # device_put is a host memcpy describing no real transfer path.
+        probe = np.zeros((batch_size, 224, 224, 3), dtype=np.uint8)
+        jax.device_put(probe).block_until_ready()  # warm the path
+        t0 = time.perf_counter()
+        jax.device_put(probe).block_until_ready()
+        h2d_mb_s = probe.nbytes / 1e6 / (time.perf_counter() - t0)
+        result["h2d_mb_per_sec"] = round(h2d_mb_s, 1)
+        result["h2d_ips_ceiling"] = round(h2d_mb_s * 1e6 / (224 * 224 * 3),
+                                          1)
     if os.environ.get("AL_BENCH_DATAPATH_DECODE_ONLY") == "1":
         # Accelerator unreachable: report the host-side numbers (the
         # phase's real subject) and skip the model pass.
@@ -241,6 +261,52 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
     result.update(ips=round(ips, 1), ips_per_chip=round(ips / n_chips, 1),
                   score_sec=round(score_sec, 1))
     return result
+
+
+def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
+                      ) -> dict:
+    """Greedy k-center selection at the paper's protocol scale: one
+    ``budget``-step lax.scan over a [50k, 2048] embedding pool (the
+    reference's subset cap, gen_jobs.py:8-13; its host loop does one
+    np.random.choice + full-matrix min per pick, coreset_sampler.py:66-105).
+    Reports picks/sec; "ips" carries picks/sec so the parent's schema
+    checks hold (unit field says which)."""
+    import numpy as np
+
+    import jax
+    from active_learning_tpu.strategies.kcenter import kcenter_greedy
+
+    device_kind = jax.devices()[0].device_kind
+    log(f"[kcenter_select] pool [{pool_n}, {dim}], budget {budget} on "
+        f"{device_kind}")
+    host_rng = np.random.default_rng(0)
+    emb = host_rng.normal(size=(pool_n, dim)).astype(np.float32)
+    labeled = np.zeros(pool_n, dtype=bool)
+    labeled[host_rng.choice(pool_n, min(1000, pool_n // 8),
+                            replace=False)] = True
+
+    # Warm-up at the SAME budget/shapes (budget is a static scan length):
+    # the first call pays the XLA compile, the timed call does not.
+    kcenter_greedy((emb,), labeled, budget, rng=np.random.default_rng(1))
+    t0 = time.perf_counter()
+    picks = kcenter_greedy((emb,), labeled, budget,
+                           rng=np.random.default_rng(2))
+    dt = time.perf_counter() - t0
+    assert len(picks) == budget and len(set(picks.tolist())) == budget
+    rate = budget / dt
+    return {
+        "phase": "kcenter_select",
+        "ips": round(rate, 1),
+        "ips_per_chip": round(rate, 1),
+        "unit": "picks/sec",
+        "n_chips": 1,  # the sequential scan runs on one chip
+        "pool_n": pool_n,
+        "dim": dim,
+        "budget": budget,
+        "select_sec": round(dt, 2),
+        "device_kind": device_kind,
+        "platform": jax.devices()[0].platform,
+    }
 
 
 def _phase_setup(config: str, batch_size: int):
@@ -336,6 +402,9 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
     if phase == "imagenet_datapath":
         yield run_datapath_phase(iters * 1000, per_chip)
         return
+    if phase == "kcenter_select":
+        yield run_kcenter_phase(iters)
+        return
     config, kind = phase.rsplit("_", 1)
     n_chips = len(jax.devices())
     batch_size = per_chip * n_chips
@@ -417,9 +486,13 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         # partitioned module's flops (verified empirically: an 8-way
         # sharded matmul reports 1/8 the single-device figure), so this is
         # per-chip achieved throughput and MFU divides by one chip's peak.
+        # Same schema as the CPU-lowering back-fill: per-image flops +
+        # flops_source.
         tflops_chip = flops_per_step * iters / dt / 1e12
-        result["gflop_per_step_per_chip"] = round(flops_per_step / 1e9, 1)
+        result["gflop_per_image"] = round(flops_per_step / per_chip / 1e9,
+                                          2)
         result["tflops_per_sec_per_chip"] = round(tflops_chip, 1)
+        result["flops_source"] = "device-cost-analysis"
         peak = _peak_tflops(device_kind)
         if peak:
             result["mfu"] = round(tflops_chip / peak, 3)
@@ -626,7 +699,7 @@ def _main_inner() -> None:
     # property of the computation, not the device) combined with the
     # TPU-measured throughput.
     for name, entry in phases.items():
-        if name == "imagenet_datapath" or entry.get("mfu") \
+        if not name.endswith(("_train", "_score")) or entry.get("mfu") \
                 or not entry.get("ips_per_chip"):
             continue
         remaining = deadline - time.monotonic()

@@ -292,3 +292,37 @@ class TestGenJobs:
                 ["--dataset", "synthetic", "--strategy", "VAALSampler",
                  flag, "2.5"])
             assert cli.args_to_config(ns).vaal.adversary_param == 2.5
+
+
+class TestBenchHarness:
+    """The benchmark harness's pure helpers (bench.py at the repo root)."""
+
+    def _bench(self):
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+        spec = importlib.util.spec_from_file_location("bench", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_parse_child_json_requires_keys(self):
+        bench = self._bench()
+        out = ('{"note": "stray library json"}\n'
+               '{"phase": "p", "ips": 1.0, "ips_per_chip": 1.0}\n'
+               '{"also": "stray"}\n')
+        got = bench._parse_child_json(out)
+        assert got == {"phase": "p", "ips": 1.0, "ips_per_chip": 1.0}
+        # With a different required set the scan must skip parseable
+        # lines missing the key instead of stopping at them.
+        flops = bench._parse_child_json(
+            '{"flops_per_image": 7.0}\n{"other": 1}\n',
+            required=("flops_per_image",))
+        assert flops == {"flops_per_image": 7.0}
+        assert bench._parse_child_json("no json here\n{broken\n") is None
+
+    def test_kcenter_phase_tiny(self):
+        bench = self._bench()
+        result = bench.run_kcenter_phase(8, dim=16, pool_n=128)
+        assert result["ips"] > 0 and result["budget"] == 8
+        assert result["unit"] == "picks/sec"

@@ -145,6 +145,47 @@ class TestMASE:
                 expected = (emb[i] @ dw + db) / np.linalg.norm(dw)
                 np.testing.assert_allclose(radii[i, j], expected, rtol=1e-4)
 
+    def test_head_pair_norms_matches_naive(self):
+        """The hoisted [C, C] table equals element-wise ||w_c - w_j||,
+        including exact zeros on the diagonal (those become the j == c
+        +inf radii downstream)."""
+        rng = np.random.default_rng(3)
+        kernel = rng.normal(size=(8, 5)).astype(np.float32)
+        got = np.asarray(scoring.head_pair_norms(jnp.asarray(kernel)))
+        w = kernel.T
+        naive = np.linalg.norm(w[:, None, :] - w[None, :, :], axis=-1)
+        np.testing.assert_allclose(got, naive, rtol=1e-6)
+        assert (np.diag(got) == 0.0).all()
+
+    def test_near_duplicate_head_columns_match_float64_oracle(self):
+        """Nearly-identical head columns are the catastrophic-cancellation
+        case: a Gram-identity denominator would report +inf, and a
+        logit-difference numerator would quantize the tiny margins to
+        float32 ulp noise.  Both the value AND finiteness must match a
+        float64 naive oracle."""
+        rng = np.random.default_rng(4)
+        d, c = 64, 6
+        kernel = rng.normal(size=(d, c)).astype(np.float32) * 10.0
+        kernel[:, 1] = kernel[:, 0]
+        kernel[0, 1] += 1e-3  # ||w_0 - w_1|| = 1e-3, tiny vs ||w|| ~ 80
+        bias = np.zeros(c, dtype=np.float32)
+        emb = rng.normal(size=(4, d)).astype(np.float32)
+        out = scoring.boundary_radii(jnp.asarray(emb), jnp.asarray(kernel),
+                                     jnp.asarray(bias))
+        radii = np.asarray(out["radii"])
+        k64, e64 = kernel.astype(np.float64), emb.astype(np.float64)
+        logits = e64 @ k64
+        preds = logits.argmax(axis=1)
+        for i in range(4):
+            for j in range(c):
+                if j == preds[i]:
+                    assert np.isinf(radii[i, j])
+                    continue
+                dw = k64[:, preds[i]] - k64[:, j]
+                expected = (e64[i] @ dw) / np.linalg.norm(dw)
+                np.testing.assert_allclose(radii[i, j], expected, rtol=1e-3,
+                                           err_msg=f"row {i} class {j}")
+
     def test_query_selects_smallest_margins(self):
         s = make_strategy("MASESampler")
         avail = s.available_query_idxs(shuffle=False)
