@@ -94,3 +94,20 @@ class TestResidentScoring:
         assert len(out["margin"]) == len(idxs)
         assert len(s._resident_pool["images"]) == 1
         assert len(s._resident_pool["steps"]) >= 2  # embed + prob_stats
+
+    def test_host_path_bulk_flush_preserves_order(self):
+        """The host path defers fetches and flushes device results every
+        32 batches; crossing several flush boundaries (and ending on a
+        partial pending buffer) must keep score rows aligned with idxs."""
+        s = make_strategy("MarginSampler", n_train=560)
+        idxs = np.arange(len(s.al_set), dtype=np.int64)
+        step = s._get_score_step("prob_stats")
+        bs = s.trainer.padded_batch_size(1)  # tiny batches -> many flushes
+        assert len(idxs) // bs > 2 * 32
+        got = scoring.collect_pool(s.al_set, idxs, bs, step,
+                                   s.state.variables, s.mesh)
+        big = scoring.collect_pool(s.al_set, idxs, s._score_batch_size(),
+                                   step, s.state.variables, s.mesh)
+        assert len(got["margin"]) == len(idxs)
+        np.testing.assert_allclose(got["margin"], big["margin"],
+                                   rtol=1e-5, atol=1e-6)
