@@ -35,6 +35,15 @@ from ..utils.logging import get_logger
 STATE_FILE = "experiment_state.npz"
 META_FILE = "experiment_state.json"
 
+# Bumped whenever saved model weights stop being interchangeable across
+# code versions even though their SHAPES still match — e.g. the conv
+# padding fix (models/resnet.py: strided 3x3 convs moved from XLA-SAME to
+# torch-exact (1, 1) padding), where old weights would load cleanly into
+# the new graph and silently score through one-pixel-shifted windows.
+# Version 1 (implicit in states saved before the field existed) = the
+# pre-padding-fix alignment.
+MODEL_FORMAT_VERSION = 2
+
 
 def _state_dir(cfg: ExperimentConfig) -> str:
     exp_hash = cfg.exp_hash or "no_hash"
@@ -56,6 +65,7 @@ def save_experiment(strategy, cfg: ExperimentConfig) -> str:
     os.replace(state_path + ".tmp.npz", state_path)
     meta = {
         "round": int(strategy.round),
+        "model_format": MODEL_FORMAT_VERSION,
         "rng_state": strategy.rng.bit_generator.state,
         "config": {k: _jsonable(v) for k, v in config_to_dict(cfg).items()},
         "experiment_key": getattr(strategy.sink, "experiment_key", None),
@@ -86,6 +96,17 @@ def load_experiment(strategy, cfg: ExperimentConfig) -> int:
         arrays = {k: arrs[k] for k in arrs.files}
     with open(os.path.join(directory, META_FILE)) as fh:
         meta = json.load(fh)
+
+    saved_fmt = int(meta.get("model_format", 1))
+    if saved_fmt != MODEL_FORMAT_VERSION:
+        # Shapes would match, so the npz/msgpack loads would succeed and
+        # the run would silently diverge — refuse instead.
+        raise RuntimeError(
+            f"Saved experiment in {directory} uses model format "
+            f"{saved_fmt}, this code writes {MODEL_FORMAT_VERSION}: its "
+            "checkpointed weights are not alignment-compatible with the "
+            "current conv padding. Restart the experiment (or re-run with "
+            "the code version that wrote it).")
 
     # Warn (don't fail) on config drift, mirroring resume_training.py:22-25.
     current = {k: _jsonable(v) for k, v in config_to_dict(cfg).items()}

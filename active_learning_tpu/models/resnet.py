@@ -54,11 +54,16 @@ class BasicBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # 3x3 convs use EXPLICIT (1, 1) padding, not "SAME": for stride-2
+        # on even spatial sizes SAME pads (0, 1) while torch's padding=1
+        # pads (1, 1) — a one-pixel window shift that silently breaks
+        # converted torch checkpoints (tests/test_torch_parity.py).
         residual = x
-        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.conv(self.filters, (3, 3), self.strides,
+                      padding=[(1, 1), (1, 1)])(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3))(y)
+        y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)])(y)
         y = self.norm(scale_init=nn.initializers.ones)(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters, (1, 1), self.strides,
@@ -81,11 +86,13 @@ class BottleneckBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # Same explicit-padding rule as BasicBlock for the strided 3x3.
         residual = x
         y = self.conv(self.filters, (1, 1))(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.conv(self.filters, (3, 3), self.strides,
+                      padding=[(1, 1), (1, 1)])(y)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters * 4, (1, 1))(y)

@@ -326,3 +326,26 @@ class TestBenchHarness:
         result = bench.run_kcenter_phase(8, dim=16, pool_n=128)
         assert result["ips"] > 0 and result["budget"] == 8
         assert result["unit"] == "picks/sec"
+
+
+def test_resume_refuses_other_model_format(tmp_path):
+    """A saved state whose weights predate a model-format bump (e.g. the
+    conv padding fix) must fail loudly on resume — shapes still match, so
+    without the guard the run would silently diverge."""
+    import json
+
+    import pytest
+
+    from active_learning_tpu.experiment import resume as resume_lib
+
+    d = tmp_path / "exp_no_hash"
+    d.mkdir(parents=True)
+    np.savez(str(d / resume_lib.STATE_FILE)[: -len(".npz")],
+             init_key=np.zeros(2, np.uint32))
+    (d / resume_lib.META_FILE).write_text(json.dumps(
+        {"round": 0, "model_format": 1, "rng_state": {}, "config": {}}))
+
+    cfg = type("Cfg", (), {})()
+    cfg.ckpt_path, cfg.exp_name, cfg.exp_hash = str(tmp_path), "exp", None
+    with pytest.raises(RuntimeError, match="model format"):
+        resume_lib.load_experiment(object(), cfg)

@@ -1,0 +1,152 @@
+"""Differential torch-vs-Flax forward parity.
+
+The reference runs torchvision ResNets (resnet_simclr.py:8-22) and the
+SSL-checkpoint workflow ports torch weights into this repo's Flax models
+(utils/pretrained.py).  Parameter-count and key-mapping tests cannot catch
+topology/numerics drift — stride placement, padding alignment, BN
+epsilon — so this module builds the same networks in raw torch (CPU,
+torchvision is not installed here), pushes their weights through the real
+converter, and requires the two frameworks to produce the SAME logits.
+
+This is the test that catches the SAME-vs-torch padding shift on strided
+3x3 convs (flax SAME pads (0, 1) on even inputs; torch padding=1 pads
+(1, 1)) — a silent one-pixel window misalignment that would degrade every
+converted checkpoint.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from active_learning_tpu.models.resnet import resnet18, resnet50  # noqa: E402
+from active_learning_tpu.utils.pretrained import overlay_torch_state  # noqa: E402
+
+
+class TorchBasicBlock(nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return torch.relu(out + idn)
+
+
+class TorchBottleneck(nn.Module):
+    """v1.5: the stride lives on the 3x3 conv."""
+
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * 4
+        self.conv1 = nn.Conv2d(cin, width, 1, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, cout, 1, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = torch.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return torch.relu(out + idn)
+
+
+class TorchEncoder(nn.Module):
+    """CIFAR-stem ResNet encoder with torchvision's attribute names, so
+    its state_dict keys are exactly what the converter maps."""
+
+    def __init__(self, block, layers, widths=(64, 128, 256, 512)):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        cin = 64
+        for i, (n, w) in enumerate(zip(layers, widths)):
+            blocks = []
+            for j in range(n):
+                stride = 2 if i > 0 and j == 0 else 1
+                blocks.append(block(cin, w, stride))
+                cin = w * (4 if block is TorchBottleneck else 1)
+            setattr(self, f"layer{i + 1}", nn.Sequential(*blocks))
+        self.out_dim = cin
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        for i in range(4):
+            x = getattr(self, f"layer{i + 1}")(x)
+        return x.mean(dim=(2, 3))
+
+
+class TorchSSLNet(nn.Module):
+    def __init__(self, block, layers, num_classes=10):
+        super().__init__()
+        self.encoder = TorchEncoder(block, layers)
+        self.linear = nn.Linear(self.encoder.out_dim, num_classes)
+
+    def forward(self, x):
+        return self.linear(self.encoder(x))
+
+
+def _randomized_state(tnet, seed):
+    """Non-trivial weights AND running stats: a few train-mode batches
+    populate BN running mean/var with real values, so the stats mapping
+    (running_* -> batch_stats) is exercised with distinguishable numbers."""
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for p in tnet.parameters():
+            p.copy_(torch.randn(p.shape, generator=g) * 0.05)
+    tnet.train()
+    with torch.no_grad():
+        for _ in range(3):
+            tnet(torch.randn(8, 3, 32, 32, generator=g))
+    tnet.eval()
+    return {k: v.numpy().copy() for k, v in tnet.state_dict().items()}
+
+
+@pytest.mark.parametrize("name", ["resnet18", "resnet50"])
+def test_forward_logits_match_torch(name):
+    if name == "resnet18":
+        tnet = TorchSSLNet(TorchBasicBlock, [2, 2, 2, 2])
+        model = resnet18(num_classes=10, cifar_stem=True)
+        tol = 2e-4
+    else:
+        tnet = TorchSSLNet(TorchBottleneck, [3, 4, 6, 3])
+        model = resnet50(num_classes=10, cifar_stem=True)
+        tol = 5e-4
+    state = _randomized_state(tnet, seed=0)
+
+    x = np.random.default_rng(1).normal(size=(4, 3, 32, 32)
+                                        ).astype(np.float32)
+    with torch.no_grad():
+        want = tnet(torch.from_numpy(x)).numpy()
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.asarray(x.transpose(0, 2, 3, 1)),
+                           train=False)
+    variables = overlay_torch_state(
+        jax.tree.map(np.asarray, dict(variables)), state)
+    got = np.asarray(model.apply(variables,
+                                 jnp.asarray(x.transpose(0, 2, 3, 1)),
+                                 train=False))
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
