@@ -74,12 +74,20 @@ class TorchBottleneck(nn.Module):
 
 
 class TorchEncoder(nn.Module):
-    """CIFAR-stem ResNet encoder with torchvision's attribute names, so
-    its state_dict keys are exactly what the converter maps."""
+    """ResNet encoder with torchvision's attribute names, so its
+    state_dict keys are exactly what the converter maps.  ``cifar_stem``
+    selects the SimCLR 3x3 stem (resnet_hacks.py:31-35) vs the standard
+    7x7 stride-2 stem + 3x3 stride-2 max pool."""
 
-    def __init__(self, block, layers, widths=(64, 128, 256, 512)):
+    def __init__(self, block, layers, widths=(64, 128, 256, 512),
+                 cifar_stem=True):
         super().__init__()
-        self.conv1 = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
+        self.cifar_stem = cifar_stem
+        if cifar_stem:
+            self.conv1 = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
+        else:
+            self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.maxpool = nn.MaxPool2d(3, 2, 1)
         self.bn1 = nn.BatchNorm2d(64)
         cin = 64
         for i, (n, w) in enumerate(zip(layers, widths)):
@@ -93,15 +101,17 @@ class TorchEncoder(nn.Module):
 
     def forward(self, x):
         x = torch.relu(self.bn1(self.conv1(x)))
+        if not self.cifar_stem:
+            x = self.maxpool(x)
         for i in range(4):
             x = getattr(self, f"layer{i + 1}")(x)
         return x.mean(dim=(2, 3))
 
 
 class TorchSSLNet(nn.Module):
-    def __init__(self, block, layers, num_classes=10):
+    def __init__(self, block, layers, num_classes=10, cifar_stem=True):
         super().__init__()
-        self.encoder = TorchEncoder(block, layers)
+        self.encoder = TorchEncoder(block, layers, cifar_stem=cifar_stem)
         self.linear = nn.Linear(self.encoder.out_dim, num_classes)
 
     def forward(self, x):
@@ -124,19 +134,27 @@ def _randomized_state(tnet, seed):
     return {k: v.numpy().copy() for k, v in tnet.state_dict().items()}
 
 
-@pytest.mark.parametrize("name", ["resnet18", "resnet50"])
+@pytest.mark.parametrize("name",
+                         ["resnet18", "resnet50", "resnet18_imagenet"])
 def test_forward_logits_match_torch(name):
+    px = 32
     if name == "resnet18":
         tnet = TorchSSLNet(TorchBasicBlock, [2, 2, 2, 2])
         model = resnet18(num_classes=10, cifar_stem=True)
         tol = 2e-4
-    else:
+    elif name == "resnet50":
         tnet = TorchSSLNet(TorchBottleneck, [3, 4, 6, 3])
         model = resnet50(num_classes=10, cifar_stem=True)
         tol = 5e-4
+    else:
+        # The ImageNet stem: 7x7 stride-2 conv + 3x3 stride-2 max pool —
+        # covers the stem/pool padding alignment the CIFAR stem skips.
+        tnet = TorchSSLNet(TorchBasicBlock, [2, 2, 2, 2], cifar_stem=False)
+        model = resnet18(num_classes=10, cifar_stem=False)
+        tol, px = 2e-4, 64
     state = _randomized_state(tnet, seed=0)
 
-    x = np.random.default_rng(1).normal(size=(4, 3, 32, 32)
+    x = np.random.default_rng(1).normal(size=(4, 3, px, px)
                                         ).astype(np.float32)
     with torch.no_grad():
         want = tnet(torch.from_numpy(x)).numpy()
