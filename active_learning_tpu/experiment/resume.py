@@ -30,19 +30,14 @@ import numpy as np
 
 from ..config import ExperimentConfig, config_to_dict
 from ..pool import PoolState
+# Shared weight-compatibility version: see its definition site for when it
+# bumps.  Both resume surfaces (this file and the mid-round fit state in
+# train/checkpoint.py) check it.
+from ..train.checkpoint import MODEL_FORMAT_VERSION
 from ..utils.logging import get_logger
 
 STATE_FILE = "experiment_state.npz"
 META_FILE = "experiment_state.json"
-
-# Bumped whenever saved model weights stop being interchangeable across
-# code versions even though their SHAPES still match — e.g. the conv
-# padding fix (models/resnet.py: strided 3x3 convs moved from XLA-SAME to
-# torch-exact (1, 1) padding), where old weights would load cleanly into
-# the new graph and silently score through one-pixel-shifted windows.
-# Version 1 (implicit in states saved before the field existed) = the
-# pre-padding-fix alignment.
-MODEL_FORMAT_VERSION = 2
 
 
 def _state_dir(cfg: ExperimentConfig) -> str:

@@ -183,8 +183,8 @@ class BalancingSampler(Strategy):
                     emb_dev, eligible_dev = device_pool_state(
                         self.mesh, embeddings, idxs_for_query)
                 if centers_dev is None:
-                    centers = (sums / (counts[:, None] + 1e-5)
-                               ).astype(np.float32)
+                    centers = np.stack(
+                        [center_row(i) for i in range(n_classes)])
                     centers_dev = mesh_lib.replicate(centers, self.mesh)
                 rarest = int(np.argmin(counts))
                 small = mesh_lib.replicate(

@@ -22,6 +22,17 @@ import jax
 import numpy as np
 from flax import serialization
 
+# Bumped whenever saved model weights stop being interchangeable across
+# code versions even though their SHAPES still match — e.g. the conv
+# padding fix (models/resnet.py: strided 3x3 convs moved from XLA-SAME to
+# torch-exact (1, 1) padding), where old weights would load cleanly into
+# the new graph and silently score through one-pixel-shifted windows.
+# Checked by BOTH resume surfaces: experiment-level (experiment/resume.py,
+# hard error) and mid-round fit state (below, discard + warn — the round
+# safely restarts from scratch).  Version 1 = states saved before the
+# field existed, i.e. the pre-padding-fix alignment.
+MODEL_FORMAT_VERSION = 2
+
 
 def save_variables(path: str, variables: Dict[str, Any]) -> None:
     """Atomic write (tmp + rename): a reader never sees a half-written
@@ -92,6 +103,7 @@ def save_fit_state(path: str, *, variables: Dict[str, Any], opt_state: Any,
     meta = {
         "epoch": int(epoch),
         "round_idx": int(round_idx),
+        "model_format": MODEL_FORMAT_VERSION,
         "step": int(np.asarray(step)),
         "best_perf": float(best_perf),
         "best_epoch": int(best_epoch),
@@ -113,6 +125,13 @@ def load_fit_state(path: str, round_idx: int) -> Optional[Dict[str, Any]]:
     with open(path + ".json") as fh:
         meta = json.load(fh)
     if meta.get("round_idx") != int(round_idx):
+        return None
+    if int(meta.get("model_format", 1)) != MODEL_FORMAT_VERSION:
+        from ..utils.logging import get_logger
+        get_logger().warning(
+            f"Discarding mid-round fit state with model format "
+            f"{meta.get('model_format', 1)} (this code writes "
+            f"{MODEL_FORMAT_VERSION}); the round restarts from scratch")
         return None
     with open(path + ".msgpack", "rb") as fh:
         trees = serialization.msgpack_restore(fh.read())

@@ -226,3 +226,28 @@ class TestMidRoundResume:
             rng=np.random.default_rng(0))
         assert ckpt_lib.load_fit_state(paths["fit_state"], 1) is None
         assert ckpt_lib.load_fit_state(paths["fit_state"], 3) is not None
+
+
+def test_fit_state_from_other_model_format_is_discarded(tmp_path):
+    """A mid-round fit state written by a code version with different
+    weight alignment (model_format mismatch) is treated as nothing-to-
+    resume: the round restarts from scratch instead of silently
+    continuing with incompatible weights."""
+    import json
+
+    import numpy as np
+
+    from active_learning_tpu.train import checkpoint as ckpt_lib
+
+    path = str(tmp_path / "fit_state_rd_0")
+    ckpt_lib.save_fit_state(
+        path, variables={"params": {"w": np.zeros(2)}},
+        opt_state={}, step=np.int32(1), epoch=3, round_idx=0,
+        best_perf=0.5, best_epoch=2, es_count=0,
+        key=np.zeros(2, np.uint32), rng=np.random.default_rng(0))
+    assert ckpt_lib.load_fit_state(path, 0) is not None
+
+    meta = json.loads(open(path + ".json").read())
+    meta["model_format"] = 1
+    open(path + ".json", "w").write(json.dumps(meta))
+    assert ckpt_lib.load_fit_state(path, 0) is None
