@@ -1,0 +1,8 @@
+"""Custom TPU kernels (Pallas).
+
+Only ops where measured XLA performance leaves headroom get a kernel —
+see DESIGN.md §5 for the decision record.  Current contents:
+
+  * kcenter_pallas — the k-center scan's per-pick fused distance-update
+    (matvec + d_new + running-min in one pass over the factor matrix).
+"""

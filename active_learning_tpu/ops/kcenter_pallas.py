@@ -1,0 +1,104 @@
+"""Pallas TPU kernel for the k-center scan's distance update.
+
+The greedy selection loop (strategies/kcenter.py) spends its time in one
+operation per pick: ``min_dist <- min(min_dist, sqn + sqn[idx] - 2 X@X[idx])``
+— a skinny matvec over the whole [N, D] factor matrix plus two [N]
+elementwise passes.  XLA runs this at well under HBM bandwidth on TPU (the
+matvec's output lane width is 1), so this kernel restructures the layout:
+
+  * the factor matrix is stored TRANSPOSED, XT [D, N], so pool rows lie
+    along the lane dimension and the matvec becomes [1, TILE_D] @
+    [TILE_D, TILE_N] MXU tiles accumulating a [1, TILE_N] strip;
+  * d_new and the running min fuse into the same pass — one read of XT,
+    one read-modify of min_dist, nothing else touches HBM.
+
+The kernel is numerically identical to the XLA path (float32 MXU
+accumulation); tests/test_kcenter_pallas.py pins it against the plain
+jnp expression in interpret mode.  Wiring into kcenter_greedy stays
+opt-in (AL_TPU_KCENTER_PALLAS=1) until the TPU A/B in bench.py shows it
+faster on the target generation — see DESIGN.md §5.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is present wherever jax is, but keep import-safe
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+TILE_N = 512
+TILE_D = 512
+
+
+def _update_kernel(sqn_idx_ref, v_ref, xt_ref, sqn_ref, min_ref, out_ref,
+                   acc_ref):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:, :] += jnp.dot(v_ref[:, :], xt_ref[:, :],
+                             preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(1) - 1)
+    def _finish():
+        d_new = sqn_ref[:, :] + sqn_idx_ref[0, 0] - 2.0 * acc_ref[:, :]
+        out_ref[:, :] = jnp.minimum(min_ref[:, :], d_new)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def min_dist_update(xt: jnp.ndarray, sqn: jnp.ndarray,
+                    min_dist: jnp.ndarray, idx: jnp.ndarray,
+                    interpret: bool = False) -> jnp.ndarray:
+    """One fused distance-update against pool row ``idx``.
+
+    xt [D, N] float32 (transposed factors, N and D multiples of the
+    tiles); sqn [1, N]; min_dist [1, N]; idx scalar int32.  Returns the
+    updated [1, N] min-distance row.
+    """
+    d, n = xt.shape
+    assert n % TILE_N == 0 and d % TILE_D == 0, (n, d)
+    v = jax.lax.dynamic_slice(xt, (0, idx), (d, 1)).T  # [1, D]
+    sqn_idx = jax.lax.dynamic_slice(sqn, (0, idx), (1, 1))  # [1, 1]
+
+    grid = (n // TILE_N, d // TILE_D)
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        _update_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda j, k: (0, 0)),          # sqn[idx]
+            pl.BlockSpec((1, TILE_D), lambda j, k: (0, k)),     # v
+            pl.BlockSpec((TILE_D, TILE_N), lambda j, k: (k, j)),  # XT
+            pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),     # sqn
+            pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),     # min_dist
+        ],
+        out_specs=pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, TILE_N), jnp.float32)] if pltpu
+        else [],
+        interpret=interpret,
+        **kwargs,
+    )(sqn_idx, v, xt, sqn, min_dist)
+
+
+def pad_to_tiles(x: jnp.ndarray) -> jnp.ndarray:
+    """Pad an [N, D] factor matrix with zero rows/cols to tile multiples
+    and return it TRANSPOSED as [D_pad, N_pad] for min_dist_update.
+    Zero-padded pool rows have distance sqn[idx] - 0 >= 0 to everything
+    and must be masked ineligible by the caller (kcenter does, via its
+    ``selectable`` vector)."""
+    n, d = x.shape
+    pad_n = (-n) % TILE_N
+    pad_d = (-d) % TILE_D
+    return jnp.pad(x, ((0, pad_n), (0, pad_d))).T

@@ -32,6 +32,7 @@ k-means++ D^2 weights, coreset_sampler.py:80-92).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -126,6 +127,30 @@ def _kcenter_scan(factors: Factors, sqn: jnp.ndarray, min_dist: jnp.ndarray,
     return picks
 
 
+@functools.partial(jax.jit, static_argnames=("budget", "interpret"))
+def _kcenter_scan_pallas(xt, sqn_row, min_dist_row, selectable, budget: int,
+                         interpret: bool) -> jnp.ndarray:
+    """Deterministic single-factor scan with the fused Pallas distance
+    update (ops/kcenter_pallas.py): identical pick semantics to
+    _kcenter_scan — argmax over the CURRENT min-distances, then one
+    fused pass updates them against the pick.  Opt-in via
+    AL_TPU_KCENTER_PALLAS (see kcenter_greedy)."""
+    from ..ops import kcenter_pallas as kp
+
+    def step(carry, _):
+        min_dist_row, selectable = carry
+        idx = jnp.argmax(jnp.where(selectable > 0, min_dist_row[0],
+                                   -jnp.inf)).astype(jnp.int32)
+        min_dist_row = kp.min_dist_update(xt, sqn_row, min_dist_row, idx,
+                                          interpret=interpret)
+        selectable = selectable.at[idx].set(0.0)
+        return (min_dist_row, selectable), idx
+
+    _, picks = jax.lax.scan(step, (min_dist_row, selectable), None,
+                            length=budget)
+    return picks
+
+
 @functools.partial(jax.jit, static_argnames=("block",))
 def _minimax_row(factors: Factors, sqn: jnp.ndarray, block: int = 2048
                  ) -> jnp.ndarray:
@@ -185,7 +210,26 @@ def kcenter_greedy(
     min_dist = min_sq_dist_to(factors, sqn, labeled_idxs)
     selectable = np.ones(n, dtype=np.float32)
     selectable[labeled_idxs] = 0.0
-    if budget > 0:
+    # Opt-in fused Pallas update for the deterministic single-factor scan
+    # (AL_TPU_KCENTER_PALLAS=1 on TPU, =interpret for CPU testing) — same
+    # picks, one fused HBM pass per step; see ops/kcenter_pallas.py and
+    # DESIGN.md §5 for why this stays opt-in.
+    pallas_mode = os.environ.get("AL_TPU_KCENTER_PALLAS", "")
+    use_pallas = (budget > 0 and not randomize and len(factors) == 1
+                  and pallas_mode in ("1", "interpret"))
+    if use_pallas:
+        from ..ops import kcenter_pallas as kp
+        xt = kp.pad_to_tiles(factors[0])
+        n_pad = xt.shape[1]
+        sqn_row = jnp.zeros((1, n_pad), jnp.float32).at[0, :n].set(sqn)
+        md_row = jnp.full((1, n_pad), jnp.inf,
+                          jnp.float32).at[0, :n].set(min_dist)
+        sel = jnp.zeros(n_pad, jnp.float32).at[:n].set(
+            jnp.asarray(selectable))
+        picks = _kcenter_scan_pallas(xt, sqn_row, md_row, sel, budget,
+                                     pallas_mode == "interpret")
+        picks = np.asarray(picks, dtype=np.int64)
+    elif budget > 0:
         picks = _kcenter_scan(factors, sqn, min_dist,
                               jnp.asarray(selectable), budget,
                               bool(randomize), key)

@@ -287,6 +287,7 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
 
     # Warm-up at the SAME budget/shapes (budget is a static scan length):
     # the first call pays the XLA compile, the timed call does not.
+    os.environ.pop("AL_TPU_KCENTER_PALLAS", None)
     kcenter_greedy((emb,), labeled, budget, rng=np.random.default_rng(1))
     t0 = time.perf_counter()
     picks = kcenter_greedy((emb,), labeled, budget,
@@ -307,6 +308,47 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
     }
+
+
+def run_kcenter_pallas_ab(budget: int, xla_result: dict, dim: int = 2048,
+                          pool_n: int = 50000):
+    """A/B the opt-in fused Pallas distance-update (ops/kcenter_pallas.py)
+    against the XLA scan just measured.  TPU only; failures are recorded,
+    never fatal — the XLA number is already with the parent."""
+    import numpy as np
+
+    import jax
+    from active_learning_tpu.strategies.kcenter import kcenter_greedy
+
+    if jax.devices()[0].platform != "tpu":
+        return None
+    host_rng = np.random.default_rng(0)
+    emb = host_rng.normal(size=(pool_n, dim)).astype(np.float32)
+    labeled = np.zeros(pool_n, dtype=bool)
+    labeled[host_rng.choice(pool_n, min(1000, pool_n // 8),
+                            replace=False)] = True
+    result = dict(xla_result)
+    os.environ["AL_TPU_KCENTER_PALLAS"] = "1"
+    try:
+        kcenter_greedy((emb,), labeled, budget,
+                       rng=np.random.default_rng(1))  # compile
+        t0 = time.perf_counter()
+        picks = kcenter_greedy((emb,), labeled, budget,
+                               rng=np.random.default_rng(2))
+        dt = time.perf_counter() - t0
+        assert len(set(picks.tolist())) == budget
+        result["pallas_ips"] = round(budget / dt, 1)
+        result["pallas_select_sec"] = round(dt, 2)
+        result["pallas_speedup"] = round(
+            result["pallas_ips"] / max(result["ips"], 1e-9), 2)
+        log(f"[kcenter_select] pallas: {budget / dt:,.0f} picks/s "
+            f"({result['pallas_speedup']}x the XLA scan)")
+    except Exception as e:
+        log(f"[kcenter_select] pallas path failed: {e!r}")
+        result["pallas_error"] = repr(e)[:200]
+    finally:
+        os.environ.pop("AL_TPU_KCENTER_PALLAS", None)
+    return result
 
 
 def _phase_setup(config: str, batch_size: int):
@@ -403,7 +445,11 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         yield run_datapath_phase(iters * 1000, per_chip)
         return
     if phase == "kcenter_select":
-        yield run_kcenter_phase(iters)
+        result = run_kcenter_phase(iters)
+        yield dict(result)  # the XLA measurement is safe with the parent
+        extra = run_kcenter_pallas_ab(iters, result)
+        if extra is not None:
+            yield extra
         return
     config, kind = phase.rsplit("_", 1)
     n_chips = len(jax.devices())
