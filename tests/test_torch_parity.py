@@ -168,3 +168,54 @@ def test_forward_logits_match_torch(name):
                                  jnp.asarray(x.transpose(0, 2, 3, 1)),
                                  train=False))
     np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+def test_moco_checkpoint_full_pipeline(tmp_path):
+    """The complete SSL ingestion path on a MoCo-v2-style torch.save file:
+    {'state_dict': ...} wrapper, 'module.' DataParallel prefix,
+    'encoder_q' -> 'encoder' rename, 'fc' projection head skipped
+    (arg_pools ssp_finetuning semantics, reference
+    ssp_finetuning.py:34-37).  The converted encoder must reproduce the
+    torch encoder's embeddings; the linear head must keep its random
+    init (the reference's partial-update semantics)."""
+    from active_learning_tpu.config import PretrainedConfig
+    from active_learning_tpu.utils.pretrained import apply_pretrained
+
+    tnet = TorchSSLNet(TorchBasicBlock, [2, 2, 2, 2])
+    _randomized_state(tnet, seed=3)
+    enc_state = tnet.encoder.state_dict()
+    ckpt = {f"module.encoder_q.{k}": torch.as_tensor(v)
+            for k, v in enc_state.items()}
+    # MoCo's projection head and queue — must be filtered out.
+    ckpt["module.encoder_q.fc.0.weight"] = torch.zeros(64, 512)
+    ckpt["module.encoder_k.conv1.weight"] = torch.zeros_like(
+        ckpt["module.encoder_q.conv1.weight"])
+    ckpt["module.queue"] = torch.zeros(128, 100)
+    path = str(tmp_path / "moco.pth")
+    torch.save({"state_dict": ckpt, "epoch": 7}, path)
+
+    model = resnet18(num_classes=10, cifar_stem=True)
+    x = np.random.default_rng(2).normal(size=(4, 3, 32, 32)
+                                        ).astype(np.float32)
+    variables = jax.tree.map(
+        np.asarray,
+        dict(model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(x.transpose(0, 2, 3, 1)),
+                        train=False)))
+    cfg = PretrainedConfig(path=path, required_key=("encoder_q",),
+                           skip_key=("fc", "queue"),
+                           replace_key=(("encoder_q", "encoder"),))
+    loaded = apply_pretrained(variables, cfg)
+
+    tnet.eval()
+    with torch.no_grad():
+        want_emb = tnet.encoder(torch.from_numpy(x)).numpy()
+    _, got_emb = model.apply(loaded, jnp.asarray(x.transpose(0, 2, 3, 1)),
+                             train=False, return_features=True)
+    np.testing.assert_allclose(np.asarray(got_emb), want_emb,
+                               rtol=2e-4, atol=2e-4)
+    # Partial update: the head was not in the checkpoint and keeps its
+    # random init bit-for-bit.
+    np.testing.assert_array_equal(
+        loaded["params"]["linear"]["kernel"],
+        variables["params"]["linear"]["kernel"])
