@@ -69,6 +69,16 @@ def main() -> None:
     step = scoring.make_prob_stats_step(model, al_set.view)
     scores = scoring.collect_pool(al_set, np.arange(48, 64), bs, step,
                                   result.state.variables, mesh)
+    # The device-resident path on a multi-process mesh (what a pod run
+    # with an in-memory pool uses): pool upload via the replicated
+    # make_array_from_callback branch, per-batch on-device gathers, one
+    # cross-host fetch — must agree with the host-batched scores above.
+    res_scores = scoring.collect_pool(al_set, np.arange(48, 64), bs, step,
+                                      result.state.variables, mesh,
+                                      resident_cache={})
+    np.testing.assert_allclose(
+        np.asarray(res_scores["margin"]), np.asarray(scores["margin"]),
+        rtol=1e-6, atol=1e-6)
 
     # BalancingSampler's device pick loop across processes: the sharded
     # pool upload takes the make_array_from_process_local_data branch, and
