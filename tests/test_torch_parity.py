@@ -219,3 +219,22 @@ def test_moco_checkpoint_full_pipeline(tmp_path):
     np.testing.assert_array_equal(
         loaded["params"]["linear"]["kernel"],
         variables["params"]["linear"]["kernel"])
+
+
+def test_converter_strict_errors():
+    """Unmappable keys and shape mismatches must raise, not silently
+    skip — a wrong checkpoint going unnoticed is the failure mode the
+    strict mode exists for (reference silently ignores them)."""
+    model = resnet18(num_classes=10, cifar_stem=True)
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    variables = jax.tree.map(
+        np.asarray, dict(model.init(jax.random.PRNGKey(0), x,
+                                    train=False)))
+    with pytest.raises(KeyError):
+        overlay_torch_state(variables,
+                            {"encoder.not_a_layer.weight":
+                             np.zeros((3, 3), np.float32)})
+    with pytest.raises(ValueError, match="Shape mismatch"):
+        overlay_torch_state(variables,
+                            {"encoder.conv1.weight":
+                             np.zeros((64, 3, 7, 7), np.float32)})
