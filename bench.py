@@ -1,13 +1,18 @@
-"""Headline benchmark: jitted train-step + pool-scoring throughput.
+"""Headline benchmark: the framework's hot loops on real hardware.
 
-Two model configs are measured, each in bfloat16 over the full local mesh:
+Six phases, bfloat16 over the full local mesh:
 
-  * resnet50_imagenet — the paper's north-star protocol model (SSLResNet50
-    at 224px, reference src/gen_jobs.py:8-13, README.md:53): train-step
-    images/sec/chip with achieved TFLOP/s and MFU, plus mesh-parallel
-    pool-scoring throughput.
-  * resnet18_cifar — the CIFAR-10 protocol model (SSLResNet18, SimCLR
-    CIFAR stem, 32px): same two phases.
+  * resnet50_imagenet train/score — the paper's north-star protocol model
+    (SSLResNet50 at 224px, reference src/gen_jobs.py:8-13, README.md:53):
+    train-step images/sec/chip with achieved TFLOP/s and MFU, plus
+    mesh-parallel pool-scoring throughput.
+  * resnet18_cifar train/score — the CIFAR-10 protocol model
+    (SSLResNet18, SimCLR CIFAR stem, 32px): same two phases.
+  * imagenet_datapath — a 50k synthetic JPEG tree through the native C++
+    decoder into the mesh scoring pass (per-core decode rate, h2d
+    bandwidth, end-to-end images/sec).
+  * kcenter_select — greedy selection at protocol scale (10k picks over a
+    [50k, 2048] pool), with an A/B of the opt-in Pallas fused update.
 
 Prints exactly ONE JSON line to stdout and always exits 0.  The headline
 triple is {"metric", "value", "unit", "vs_baseline"}; per-phase numbers
