@@ -1,6 +1,6 @@
 """Headline benchmark: the framework's hot loops on real hardware.
 
-Six phases, bfloat16 over the full local mesh:
+Eight phases, bfloat16 over the full local mesh:
 
   * resnet50_imagenet train/score — the paper's north-star protocol model
     (SSLResNet50 at 224px, reference src/gen_jobs.py:8-13, README.md:53):
@@ -13,6 +13,11 @@ Six phases, bfloat16 over the full local mesh:
     bandwidth, end-to-end images/sec).
   * kcenter_select — greedy selection at protocol scale (10k picks over a
     [50k, 2048] pool), with an A/B of the opt-in Pallas fused update.
+  * al_round_cifar / al_round_imagenet — BASELINE.md metric #1: one REAL
+    end-to-end AL round (query -> train -> test) through the production
+    driver (experiment/driver.py), with the per-phase wall-clock the
+    driver already timers.  Two rounds run so the warm round (all XLA
+    compiles cached) is reported separately from the cold one.
 
 Prints exactly ONE JSON line to stdout and always exits 0.  The headline
 triple is {"metric", "value", "unit", "vs_baseline"}; per-phase numbers
@@ -21,12 +26,25 @@ degraded backend the line still appears with value null and the failure
 reasons recorded — a flaky remote runtime must never cost a round its
 performance evidence.
 
-Robustness: every phase runs in its own subprocess with a hard timeout
-(a hung remote dispatch cannot wedge the parent), backend-init failures
-retry with backoff, iteration counts shrink on retry, and batch sizes
-shrink on OOM.  Timing forces a host fetch of a value data-dependent on
-every step — block_until_ready can return early on remote-execution
-backends, host fetches cannot.
+Robustness (the round-3 driver capture died rc=124 with a full cache on
+disk; none of these may regress):
+  * A <=90 s health probe (tiny jitted matmul in a subprocess) runs
+    BEFORE any long phase attempt; a dead/degraded backend routes
+    straight to emitting the cached numbers instead of burning the
+    wall-clock budget on doomed 900-second attempts.
+  * The would-be-final JSON is rewritten to bench_partial.json after
+    every phase, so even a SIGKILL leaves the evidence on disk.
+  * SIGTERM/SIGINT print the final JSON line immediately and exit 0 — an
+    outer `timeout` on this process yields a parsed result, not rc=124.
+  * Every phase runs in its own subprocess with a hard timeout (a hung
+    remote dispatch cannot wedge the parent), the retry ladder is capped
+    at 2 attempts, iteration counts shrink on retry, and batch sizes
+    shrink on OOM.  Total fresh-capture time is bounded by
+    AL_BENCH_BUDGET_S (default 1400 s) so the guaranteed line lands well
+    inside a 30-minute outer timeout.
+  * Timing forces a host fetch of a value data-dependent on every step —
+    block_until_ready can return early on remote-execution backends,
+    host fetches cannot.
 
 vs_baseline: the reference publishes no throughput numbers (BASELINE.md)
 so the comparison points are the documented envelope of its hardware —
@@ -40,6 +58,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -83,8 +102,22 @@ PHASES = [
     # the pool to 50k and picks 10k per round (gen_jobs.py:8-13).  iters
     # is the budget (picks); per-chip batch is unused.
     ("kcenter_select", 10000, 128, 600),
+    # BASELINE.md metric #1: real end-to-end AL rounds through the
+    # production driver.  iters is the per-round epoch count.
+    ("al_round_cifar", 4, 128, 900),
+    ("al_round_imagenet", 2, 128, 900),
 ]
-TOTAL_BUDGET_S = 3000.0  # stop launching attempts past this wall-clock
+# Stop launching fresh attempts past this wall-clock: the guaranteed JSON
+# line must land WELL inside the driver's outer timeout (round 3 died at
+# rc=124 against a ~50-minute ladder).  Probe + phases + emit fit in this.
+TOTAL_BUDGET_S = float(os.environ.get("AL_BENCH_BUDGET_S", "1400"))
+# Probe slower than this => the backend is degraded; don't start fresh
+# 900-second phase attempts against it.
+PROBE_DEGRADED_S = 60.0
+# The would-be-final JSON is rewritten here after every phase, so even a
+# SIGKILL mid-run leaves complete evidence of everything captured so far.
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_partial.json")
 
 
 def log(msg: str) -> None:
@@ -243,7 +276,36 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
         return result
 
     # Full scoring pass over the whole tree, decode overlapped with device
-    # compute exactly as a real acquisition round runs it.
+    # compute exactly as a real acquisition round runs it — INCLUDING the
+    # production decoded-pool memmap cache (driver wires it the same way),
+    # so this timed pass is round 0 (decode + cache write) and the second
+    # pass below is every later round (pure cache read, bounded by
+    # h2d/page cache instead of JPEG decode).
+    import shutil
+
+    from active_learning_tpu.data.cache import maybe_wrap_decoded
+    cache_dir = os.path.join(tempfile.gettempdir(),
+                             "al_tpu_decoded_bench")
+    shutil.rmtree(cache_dir, ignore_errors=True)  # measure a COLD round 0
+    cached_set = maybe_wrap_decoded(dataset, cache_dir, 32 << 30)
+    result["decoded_cache"] = cached_set is not dataset
+    try:
+        return _datapath_model_passes(result, dataset, cached_set,
+                                      batch_size, threads, mesh)
+    finally:
+        # Pool-sized uint8 data must not squat in tempdir after the bench.
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def _datapath_model_passes(result, dataset, cached_set, batch_size,
+                           threads, mesh):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from active_learning_tpu.strategies import scoring
+
+    n_chips = result["n_chips"]
     model, _, _, _, score_view = _model_and_views("resnet50_imagenet")
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((8, 224, 224, 3), jnp.float32),
@@ -257,7 +319,7 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
                          keys=("margin",))
     all_idxs = np.arange(len(dataset))
     t0 = time.perf_counter()
-    out = scoring.collect_pool(dataset, all_idxs, batch_size, step,
+    out = scoring.collect_pool(cached_set, all_idxs, batch_size, step,
                                variables, mesh, num_workers=threads,
                                prefetch=4, keys=("margin",))
     score_sec = time.perf_counter() - t0
@@ -265,6 +327,16 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
     ips = len(dataset) / score_sec
     result.update(ips=round(ips, 1), ips_per_chip=round(ips / n_chips, 1),
                   score_sec=round(score_sec, 1))
+    if cached_set is not dataset:
+        # Steady state: rounds 1+ re-score the pool from the warm cache.
+        t0 = time.perf_counter()
+        out = scoring.collect_pool(cached_set, all_idxs, batch_size, step,
+                                   variables, mesh, num_workers=threads,
+                                   prefetch=4, keys=("margin",))
+        warm_sec = time.perf_counter() - t0
+        assert len(out["margin"]) == len(dataset)
+        result.update(ips_warm=round(len(dataset) / warm_sec, 1),
+                      warm_score_sec=round(warm_sec, 1))
     return result
 
 
@@ -312,14 +384,19 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
         "select_sec": round(dt, 2),
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
-    }
+    }, picks
 
 
-def run_kcenter_pallas_ab(budget: int, xla_result: dict, dim: int = 2048,
+def run_kcenter_pallas_ab(budget: int, xla_result: dict,
+                          xla_picks, dim: int = 2048,
                           pool_n: int = 50000):
     """A/B the opt-in fused Pallas distance-update (ops/kcenter_pallas.py)
-    against the XLA scan just measured.  TPU only; failures are recorded,
-    never fatal — the XLA number is already with the parent."""
+    against the XLA scan just measured.  ``xla_picks`` is the timed
+    phase's pick sequence (deterministic mode ignores the PRNG key), the
+    baseline for the on-hardware pick-equality check the interpret-mode
+    tests cannot provide (MXU accumulation order differs; an argmax tie
+    could flip a pick).  TPU only; failures are recorded, never fatal —
+    the XLA number is already with the parent."""
     import numpy as np
 
     import jax
@@ -335,25 +412,169 @@ def run_kcenter_pallas_ab(budget: int, xla_result: dict, dim: int = 2048,
     result = dict(xla_result)
     os.environ["AL_TPU_KCENTER_PALLAS"] = "1"
     try:
+        # Inside the try: if the kernel MODULE itself fails to import,
+        # that is a pallas_error record, not a child crash.
+        from active_learning_tpu.ops import kcenter_pallas as kp
+        kp.LAST_FALLBACK_ERROR = None
         kcenter_greedy((emb,), labeled, budget,
                        rng=np.random.default_rng(1))  # compile
         t0 = time.perf_counter()
         picks = kcenter_greedy((emb,), labeled, budget,
                                rng=np.random.default_rng(2))
         dt = time.perf_counter() - t0
+        if kp.LAST_FALLBACK_ERROR is not None:
+            # The XLA fallback answered: there IS no Pallas measurement,
+            # and recording one would fake a working kernel.
+            raise RuntimeError(
+                f"kernel fell back to XLA: {kp.LAST_FALLBACK_ERROR}")
         assert len(set(picks.tolist())) == budget
         result["pallas_ips"] = round(budget / dt, 1)
         result["pallas_select_sec"] = round(dt, 2)
         result["pallas_speedup"] = round(
             result["pallas_ips"] / max(result["ips"], 1e-9), 2)
+        result["pallas_picks_match"] = bool(np.array_equal(picks, xla_picks))
         log(f"[kcenter_select] pallas: {budget / dt:,.0f} picks/s "
-            f"({result['pallas_speedup']}x the XLA scan)")
+            f"({result['pallas_speedup']}x the XLA scan), picks_match="
+            f"{result['pallas_picks_match']}")
     except Exception as e:
         log(f"[kcenter_select] pallas path failed: {e!r}")
         result["pallas_error"] = repr(e)[:200]
     finally:
         os.environ.pop("AL_TPU_KCENTER_PALLAS", None)
     return result
+
+
+def run_al_round_phase(config: str, epochs: int) -> dict:
+    """One REAL end-to-end AL experiment through the production driver —
+    BASELINE.md metric #1 ("AL round wall-clock"), mirroring the
+    reference's per-phase prints (src/main_al.py:160-178).
+
+    Runs TWO rounds with ``init_pool_size=0`` so round 0 exercises the
+    full query -> train -> test loop cold (XLA compiles included) and
+    round 1 repeats it warm: the warm round is the steady-state number an
+    8/30-round protocol run amortizes to.  Configs:
+
+      * cifar: the CIFAR-10 protocol shape (BASELINE.md config #2) —
+        50k-image in-memory pool at 32px, SSLResNet18, MarginSampler,
+        budget 1000, the default arg pool's hyperparameters.
+      * imagenet: the ImageNet protocol scaled 1/25 (BASELINE.md #4/#5)
+        — the shared 50k synthetic JPEG tree via ImageFolderDataset +
+        native decode, SSLResNet50, MarginSampler, budget 2000.
+
+    The model precision is whatever the production path resolves
+    ("auto" => bf16 on TPU), NOT a bench-only override — this phase
+    exists to measure the loop users actually run."""
+    import shutil
+    import tempfile
+
+    import jax
+    from active_learning_tpu.config import ExperimentConfig
+    from active_learning_tpu.experiment.arg_pools import get_train_config
+    from active_learning_tpu.experiment.driver import run_experiment
+    from active_learning_tpu.utils.metrics import MetricsSink
+
+    class CaptureSink(MetricsSink):
+        def __init__(self):
+            self.metrics = []  # (name, value, step)
+
+        def log_parameters(self, params):
+            pass
+
+        def log_metrics(self, metrics, step=None):
+            for k, v in metrics.items():
+                self.metrics.append((k, float(v), step))
+
+        def log_asset(self, name, data):
+            pass
+
+    # Smoke scale (CI / CPU): 1/25 of everything so the phase's full code
+    # path — driver, sink capture, both dataset kinds — runs in seconds.
+    smoke = os.environ.get("AL_BENCH_ROUND_SMOKE") == "1"
+    pool_n, test_n = (2000, 500) if smoke else (50000, 10000)
+    if config == "cifar":
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        data = get_data_synthetic(n_train=pool_n, n_test=test_n)
+        train_cfg = get_train_config("default", "cifar10")
+        dataset, model_name = "cifar10", "SSLResNet18"
+        budget = 40 if smoke else 1000
+    else:
+        from active_learning_tpu.data.core import IMAGENET_NORM, ViewSpec
+        from active_learning_tpu.data.imagenet import ImageFolderDataset
+        root = os.path.join(tempfile.gettempdir(), "al_tpu_datapath")
+        _ensure_jpeg_tree(root, pool_n)
+        train_view = ViewSpec(IMAGENET_NORM, augment=True, pad=0)
+        val_view = ViewSpec(IMAGENET_NORM, augment=False)
+        train_set = ImageFolderDataset(root, train_view, True, limit=pool_n)
+        al_set = ImageFolderDataset(root, val_view, False, limit=pool_n)
+        test_set = ImageFolderDataset(root, val_view, False,
+                                      limit=min(5000, test_n))
+        data = (train_set, test_set, al_set)
+        train_cfg = get_train_config("default", "imagenet")
+        dataset, model_name = "imagenet", "SSLResNet50"
+        budget = 40 if smoke else 2000
+
+    tmp = tempfile.mkdtemp(prefix="al_bench_round_")
+    sink = CaptureSink()
+    # The decoded-pool cache lives inside this phase's tmp dir (deleted on
+    # exit): round 0 must pay real JPEG decode every bench invocation —
+    # the driver's persistent default dir would make later runs' "cold"
+    # round silently warm.
+    import dataclasses
+    train_cfg = dataclasses.replace(
+        train_cfg, decoded_cache_dir=os.path.join(tmp, "decoded"))
+    cfg = ExperimentConfig(
+        dataset=dataset, strategy="MarginSampler", rounds=2,
+        round_budget=budget, init_pool_size=0, model=model_name,
+        n_epoch=epochs, early_stop_patience=epochs, enable_metrics=True,
+        log_dir=tmp, ckpt_path=tmp, exp_hash="bench")
+    device_kind = jax.devices()[0].device_kind
+    n_chips = len(jax.devices())
+    log(f"[al_round_{config}] {model_name} x{n_chips} {device_kind}, "
+        f"budget {budget}, {epochs} epochs, 2 rounds")
+    t0 = time.perf_counter()
+    try:
+        run_experiment(cfg, sink=sink, data=data, train_cfg=train_cfg)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    total_sec = time.perf_counter() - t0
+
+    def phase_sec(name, rd):
+        for k, v, step in sink.metrics:
+            if k == f"rd_{name}" and step == rd:
+                return round(v, 2)
+        return None
+
+    names = ("query_time", "init_network_weights_time", "train_time",
+             "load_best_ckpt_time", "test_time")
+    rounds = {
+        f"round{rd}": {n: phase_sec(n, rd) for n in names} for rd in (0, 1)
+    }
+    warm = sum(v for v in rounds["round1"].values() if v)
+    cold = sum(v for v in rounds["round0"].values() if v)
+    # Warm-round training throughput: round 1 trains on 2*budget labeled
+    # rows for `epochs` epochs (init_pool_size=0: round 0 labeled the
+    # first `budget`).
+    train_sec = rounds["round1"]["train_time"] or float("nan")
+    ips = 2 * budget * epochs / train_sec
+    test_acc = next((v for k, v, s in sink.metrics
+                     if k == "rd_test_accuracy" and s == 1), None)
+    return {
+        "phase": f"al_round_{config}",
+        "ips": round(ips, 1),
+        "ips_per_chip": round(ips / n_chips, 1),
+        "unit": "train images/sec (in-loop)",
+        "n_chips": n_chips,
+        "budget": budget,
+        "epochs": epochs,
+        "pool_n": pool_n,
+        "round_sec_warm": round(warm, 2),
+        "round_sec_cold": round(cold, 2),
+        "total_sec": round(total_sec, 1),
+        "phases_sec": rounds,
+        "test_accuracy_rd1": test_acc,
+        "device_kind": device_kind,
+        "platform": jax.devices()[0].platform,
+    }
 
 
 def _phase_setup(config: str, batch_size: int):
@@ -449,10 +670,13 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
     if phase == "imagenet_datapath":
         yield run_datapath_phase(iters * 1000, per_chip)
         return
+    if phase.startswith("al_round_"):
+        yield run_al_round_phase(phase[len("al_round_"):], iters)
+        return
     if phase == "kcenter_select":
-        result = run_kcenter_phase(iters)
+        result, xla_picks = run_kcenter_phase(iters)
         yield dict(result)  # the XLA measurement is safe with the parent
-        extra = run_kcenter_pallas_ab(iters, result)
+        extra = run_kcenter_pallas_ab(iters, result, xla_picks)
         if extra is not None:
             yield extra
         return
@@ -531,6 +755,41 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         "platform": jax.devices()[0].platform,
     }
     yield dict(result)  # the measurement is safe with the parent now
+
+    if kind == "train" and jax.devices()[0].platform == "tpu":
+        # Batch-size lever for the MFU question (VERDICT r3 #4: train MFU
+        # 32% vs 39% scoring): measure the same step at 2x per-chip batch.
+        # Kept separate from the primary number so the series stays
+        # comparable across rounds.
+        try:
+            alt_pc = per_chip * 2
+            (_m2, _mod2, n_cls2, tv2, _sv2, trainer2, batch2,
+             state2) = _phase_setup(config, alt_pc * n_chips)
+            cw2 = jnp.ones(n_cls2, jnp.float32)
+            key2 = jax.random.PRNGKey(2)
+            for _ in range(2):
+                key2, sub2 = jax.random.split(key2)
+                state2, loss2 = trainer2._train_step(
+                    state2, batch2, sub2, jnp.float32(0.1), cw2, view=tv2)
+            float(loss2)
+            alt_iters = max(10, iters // 2)
+            t0 = time.perf_counter()
+            for _ in range(alt_iters):
+                key2, sub2 = jax.random.split(key2)
+                state2, loss2 = trainer2._train_step(
+                    state2, batch2, sub2, jnp.float32(0.1), cw2, view=tv2)
+            float(loss2)
+            alt_dt = time.perf_counter() - t0
+            result["alt_batch_per_chip"] = alt_pc
+            result["alt_ips_per_chip"] = round(
+                alt_pc * n_chips * alt_iters / alt_dt / n_chips, 1)
+            log(f"[{phase}] batch {alt_pc}/chip: "
+                f"{result['alt_ips_per_chip']:,.0f} img/s/chip "
+                f"(vs {result['ips_per_chip']:,.0f} at {per_chip})")
+            yield dict(result)
+        except Exception as e:
+            log(f"[{phase}] alt-batch probe failed: {e!r}")
+
     flops_per_step = flops_fn()
     if flops_per_step:
         # cost_analysis on a jitted SPMD executable reports the PER-DEVICE
@@ -573,15 +832,18 @@ def _parse_child_json(stdout: str, required=("ips", "ips_per_chip")):
 
 
 def run_phase_with_retries(name: str, iters: int, per_chip: int,
-                           timeout: float, deadline: float):
-    """Up to 3 attempts; iters halve per retry, batch halves on OOM.
-    The datapath phase gets a 4th attempt on the CPU backend: its
+                           timeout: float, deadline: float,
+                           max_attempts: int = 2):
+    """Capped retry ladder (default 2 attempts — a third attempt against a
+    backend that already ate two timeouts is how round 3 burned its whole
+    budget on one phase); iters halve per retry, batch halves on OOM.
+    The datapath phase gets one extra attempt on the CPU backend: its
     headline metrics (decode imgs/sec, per-core rate) are host-side, so a
     dead accelerator tunnel must not erase them — the result is tagged
     with platform "cpu" by the child itself.
     Returns (result dict | None, failure string | None)."""
     failure = None
-    attempts = 4 if name == "imagenet_datapath" else 3
+    attempts = max_attempts + 1 if name == "imagenet_datapath" else max_attempts
     for attempt in range(attempts):
         cpu_fallback = name == "imagenet_datapath" and attempt == attempts - 1
         remaining = deadline - time.monotonic()
@@ -647,34 +909,45 @@ def run_phase_with_retries(name: str, iters: int, per_chip: int,
     return None, failure
 
 
-def main() -> None:
-    try:
-        _main_inner()
-    except Exception as e:  # the JSON line must appear no matter what
-        log(f"[parent] fatal: {e!r}")
-        print(json.dumps({
-            "metric": "train_images_per_sec_per_chip", "value": None,
-            "unit": "images/sec/chip", "vs_baseline": None,
-            "error": repr(e),
-        }), flush=True)
+# Mutable orchestration state shared with the signal handler: the final
+# JSON can be assembled and printed at ANY moment.
+_STATE: dict = {"start": None, "phases": {}, "failures": {}, "cache": {},
+                "probe": None, "emitted": False}
 
 
-def _probe_hardware(timeout: float = 120.0):
-    """(device_kind, n_devices) of the live backend via a subprocess, or
-    None when the backend is unreachable — which is exactly when the cache
-    fallback is being considered."""
-    code = ("import jax; d = jax.devices(); "
-            "print(d[0].device_kind + '|' + str(len(d)))")
+def _probe_health(timeout: float = 90.0) -> dict:
+    """Health-probe the default backend in a subprocess BEFORE any long
+    phase attempt: backend init + one tiny jitted matmul with a host
+    fetch.  Returns {"ok", "seconds", "device_kind", "n_devices",
+    "platform"} or {"ok": False, "error"}.  A dead tunnel hangs inside
+    the child (possibly at interpreter start — the sitecustomize hook
+    dials the relay), so the subprocess timeout IS the detection."""
+    code = (
+        "import time; t0 = time.time()\n"
+        "import jax, jax.numpy as jnp\n"
+        "d = jax.devices()\n"
+        "x = jnp.ones((512, 512), jnp.bfloat16)\n"
+        "float((x @ x).sum())\n"
+        "print('PROBE|%s|%d|%s|%.1f'\n"
+        "      % (d[0].device_kind, len(d), d[0].platform,\n"
+        "         time.time() - t0), flush=True)\n")
+    t0 = time.perf_counter()
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               capture_output=True, text=True,
                               timeout=timeout)
-        if proc.returncode == 0 and "|" in proc.stdout:
-            kind, n = proc.stdout.strip().rsplit("|", 1)
-            return kind, int(n)
-    except (subprocess.SubprocessError, ValueError, OSError):
-        pass
-    return None
+    except subprocess.SubprocessError as e:
+        return {"ok": False,
+                "error": f"probe {type(e).__name__} after {timeout:.0f}s"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("PROBE|"):
+            _, kind, n, platform, secs = line.split("|")
+            return {"ok": True, "device_kind": kind, "n_devices": int(n),
+                    "platform": platform, "seconds": float(secs),
+                    "probe_wall_sec": round(time.perf_counter() - t0, 1)}
+    tail = (proc.stderr or "").strip().splitlines()
+    return {"ok": False, "error": f"probe exit {proc.returncode}: "
+                                  f"{tail[-1] if tail else 'no output'}"}
 
 
 def _load_cache() -> dict:
@@ -696,15 +969,165 @@ def _save_cache(cache: dict) -> None:
         log(f"[parent] cache write failed: {e!r}")
 
 
+def _finalize() -> dict:
+    """Assemble the final output dict from _STATE at ANY moment: phases
+    not (yet) freshly captured fall back to cache entries whose hardware
+    matches the probed backend (unverifiable when the probe failed —
+    marked, not dropped)."""
+    phases = dict(_STATE["phases"])
+    failures = dict(_STATE["failures"])
+    cache = _STATE["cache"]
+    probe = _STATE["probe"] or {}
+    hw = ((probe.get("device_kind"), probe.get("n_devices"))
+          if probe.get("ok") else None)
+    for name, _, _, _ in PHASES:
+        if name in phases or name not in cache:
+            continue
+        entry = cache[name]
+        if hw is not None and (entry.get("device_kind"),
+                               entry.get("n_chips")) != hw:
+            failures.setdefault(
+                name, f"cached result is from {entry.get('device_kind')} "
+                      f"x{entry.get('n_chips')}, live is {hw[0]} x{hw[1]}")
+            continue
+        phases[name] = dict(entry, cached=True,
+                            fresh_failure=failures.pop(
+                                name, "not attempted"))
+        if hw is None:
+            phases[name]["device_unverified"] = True
+
+    # Headline: the north-star model if captured, else the CIFAR model.
+    headline = None
+    for name in ("resnet50_imagenet_train", "resnet18_cifar_train",
+                 "resnet50_imagenet_score", "resnet18_cifar_score",
+                 "imagenet_datapath"):
+        # A decode-only datapath result is a host decode rate, not model
+        # throughput — never the headline.
+        if name in phases and not phases[name].get("decode_only"):
+            headline = name
+            break
+
+    out = {
+        "metric": (f"{headline}_images_per_sec_per_chip" if headline
+                   else "train_images_per_sec_per_chip"),
+        "value": phases[headline].get("ips_per_chip") if headline else None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "phases": phases,
+        "backend_probe": probe,
+        "elapsed_sec": round(time.monotonic() - _STATE["start"], 1),
+    }
+    if headline:
+        base = V100_BASELINE_IPS.get(headline)
+        if base:
+            out["vs_baseline"] = round(out["value"] / base, 3)
+        if phases[headline].get("cached"):
+            out["headline_cached"] = True
+    if failures:
+        out["failed_phases"] = failures
+    return out
+
+
+def _write_partial() -> None:
+    """Persist the would-be-final JSON after every phase: a SIGKILL (which
+    no handler can catch) still leaves the full evidence on disk."""
+    try:
+        out = dict(_finalize(), partial=True)
+        tmp = f"{PARTIAL_PATH}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(out, fh, indent=1)
+        os.replace(tmp, PARTIAL_PATH)
+    except OSError as e:
+        log(f"[parent] partial write failed: {e!r}")
+
+
+def _emit_final(extra: dict = None) -> None:
+    """Print THE one JSON line (exactly once, no matter how many paths
+    race to it) and mirror it to bench_partial.json.  SIGTERM/SIGINT are
+    masked for the duration: without the mask, a signal landing between
+    flag-set and print would find 'emitted' already True in the handler
+    and os._exit before the main thread's print runs — zero output, the
+    exact rc=124/parsed=null failure this machinery exists to prevent.
+    A _finalize crash (e.g. a malformed cache entry) degrades to a
+    minimal error line rather than suppressing output entirely."""
+    old_mask = signal.pthread_sigmask(
+        signal.SIG_BLOCK, {signal.SIGTERM, signal.SIGINT})
+    try:
+        if _STATE["emitted"]:
+            return
+        try:
+            out = _finalize()
+            if extra:
+                out.update(extra)
+            line = json.dumps(out)
+        except Exception as e:
+            log(f"[parent] finalize failed: {e!r}")
+            out = {"metric": "train_images_per_sec_per_chip", "value": None,
+                   "unit": "images/sec/chip", "vs_baseline": None,
+                   "error": f"finalize failed: {e!r}"}
+            line = json.dumps(out)
+        try:
+            with open(f"{PARTIAL_PATH}.tmp", "w") as fh:
+                json.dump(out, fh, indent=1)
+            os.replace(f"{PARTIAL_PATH}.tmp", PARTIAL_PATH)
+        except OSError:
+            pass
+        print(line, flush=True)
+        _STATE["emitted"] = True
+    finally:
+        signal.pthread_sigmask(signal.SIG_SETMASK, old_mask)
+
+
+def _signal_emit(signum, frame):
+    """An outer `timeout`'s SIGTERM (or a ^C) becomes a parsed result: the
+    round-3 harness recorded rc=124/parsed=null while a complete cache sat
+    on disk — the line must go out BEFORE the process dies."""
+    log(f"[parent] caught signal {signum}; emitting evidence now")
+    _emit_final(extra={"interrupted_by_signal": signum})
+    os._exit(0)
+
+
+def main() -> None:
+    _STATE["start"] = time.monotonic()
+    _STATE["cache"] = _load_cache()
+    signal.signal(signal.SIGTERM, _signal_emit)
+    signal.signal(signal.SIGINT, _signal_emit)
+    try:
+        _main_inner()
+        _emit_final()
+    except Exception as e:  # the JSON line must appear no matter what
+        log(f"[parent] fatal: {e!r}")
+        _emit_final(extra={"error": repr(e)})
+
+
 def _main_inner() -> None:
-    start = time.monotonic()
-    deadline = start + TOTAL_BUDGET_S
-    cache = _load_cache()
-    phases: dict = {}
-    failures: dict = {}
-    for name, iters, per_chip, timeout in PHASES:
-        result, failure = run_phase_with_retries(name, iters, per_chip,
-                                                 timeout, deadline)
+    deadline = _STATE["start"] + TOTAL_BUDGET_S
+    cache = _STATE["cache"]
+    phases: dict = _STATE["phases"]
+    failures: dict = _STATE["failures"]
+
+    probe = _probe_health()
+    _STATE["probe"] = probe
+    if not probe.get("ok"):
+        log(f"[parent] backend probe failed ({probe.get('error')}); "
+            "emitting cached evidence without fresh attempts")
+        return
+    log(f"[parent] backend healthy: {probe['device_kind']} "
+        f"x{probe['n_devices']} ({probe['platform']}), probe "
+        f"{probe['seconds']:.1f}s")
+    degraded = probe["seconds"] > PROBE_DEGRADED_S
+    if degraded:
+        log(f"[parent] probe took {probe['seconds']:.0f}s — degraded "
+            "backend: single attempts, fresh-only phases first")
+
+    # Phases with no cache entry carry the only NEW evidence this run can
+    # produce — capture them first so a mid-run death costs the least.
+    order = sorted(PHASES, key=lambda p: (
+        p[0] in cache, cache.get(p[0], {}).get("captured_utc", "")))
+    for name, iters, per_chip, timeout in order:
+        result, failure = run_phase_with_retries(
+            name, iters, per_chip, timeout, deadline,
+            max_attempts=1 if degraded else 2)
         if result is not None:
             result["captured_utc"] = time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -719,37 +1142,18 @@ def _main_inner() -> None:
                 f"{result['ips_per_chip']:,.0f} img/s/chip")
         else:
             failures[name] = failure
-
-    # Cache fallback for failed phases, AFTER the loop so the hardware
-    # probe never contends with a running phase.  Numbers captured on
-    # DIFFERENT hardware are never resurrected: reuse requires the cached
-    # device_kind/chip count to match the live backend (when the backend
-    # is unreachable — the usual reason for the fallback — the entry is
-    # marked device_unverified instead).
-    missing = [n for n in failures if n in cache]
-    if missing:
-        hw = _probe_hardware()
-        for name in missing:
-            entry = cache[name]
-            if hw is not None and (entry.get("device_kind"),
-                                   entry.get("n_chips")) != hw:
-                log(f"[parent] {name}: cached result is from "
-                    f"{entry.get('device_kind')} x{entry.get('n_chips')}, "
-                    f"live backend is {hw[0]} x{hw[1]}; not reusing")
-                continue
-            phases[name] = dict(entry, cached=True,
-                                fresh_failure=failures.pop(name))
-            if hw is None:
-                phases[name]["device_unverified"] = True
-            log(f"[parent] {name}: fresh capture failed; using cached "
-                f"result from {entry.get('captured_utc')}")
+        _write_partial()
 
     # MFU back-fill: cost_analysis is unavailable on the tunneled TPU
     # backend, so phases that timed or errored out of the on-device flops
     # enrichment get their FLOP count from an identical CPU lowering (a
     # property of the computation, not the device) combined with the
-    # TPU-measured throughput.
-    for name, entry in phases.items():
+    # TPU-measured throughput.  Runs over fresh AND cache-fallback
+    # entries; PALLAS_AXON_POOL_IPS is cleared so the child's interpreter
+    # cannot hang dialing a dead tunnel (the hook runs at startup).
+    for name, entry in list(phases.items()) + [
+            (n, cache[n]) for n, _, _, _ in PHASES
+            if n in cache and n not in phases]:
         if not name.endswith(("_train", "_score")) or entry.get("mfu") \
                 or not entry.get("ips_per_chip"):
             continue
@@ -761,7 +1165,8 @@ def _main_inner() -> None:
         cmd = [sys.executable, os.path.abspath(__file__), "--phase", name,
                "--flops-cpu", "--per-chip-batch",
                str(min(32, entry.get("batch_per_chip", 128)))]
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
         log(f"[parent] {name}: computing FLOPs via CPU lowering")
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
@@ -789,36 +1194,7 @@ def _main_inner() -> None:
                            if k not in ("cached", "fresh_failure",
                                         "device_unverified")}
             _save_cache(cache)
-
-    # Headline: the north-star model if captured, else the CIFAR model.
-    headline = None
-    for name in ("resnet50_imagenet_train", "resnet18_cifar_train",
-                 "resnet50_imagenet_score", "resnet18_cifar_score",
-                 "imagenet_datapath"):
-        # A decode-only datapath result is a host decode rate, not model
-        # throughput — never the headline.
-        if name in phases and not phases[name].get("decode_only"):
-            headline = name
-            break
-
-    out = {
-        "metric": (f"{headline}_images_per_sec_per_chip" if headline
-                   else "train_images_per_sec_per_chip"),
-        "value": phases[headline]["ips_per_chip"] if headline else None,
-        "unit": "images/sec/chip",
-        "vs_baseline": None,
-        "phases": phases,
-        "elapsed_sec": round(time.monotonic() - start, 1),
-    }
-    if headline:
-        base = V100_BASELINE_IPS.get(headline)
-        if base:
-            out["vs_baseline"] = round(out["value"] / base, 3)
-        if phases[headline].get("cached"):
-            out["headline_cached"] = True
-    if failures:
-        out["failed_phases"] = failures
-    print(json.dumps(out), flush=True)
+        _write_partial()
 
 
 if __name__ == "__main__":

@@ -324,9 +324,12 @@ class TestBenchHarness:
 
     def test_kcenter_phase_tiny(self):
         bench = self._bench()
-        result = bench.run_kcenter_phase(8, dim=16, pool_n=128)
+        result, picks = bench.run_kcenter_phase(8, dim=16, pool_n=128)
         assert result["ips"] > 0 and result["budget"] == 8
         assert result["unit"] == "picks/sec"
+        # The timed picks ride along so the Pallas A/B can compare
+        # without re-running the whole XLA scan.
+        assert len(picks) == 8 and len(set(picks.tolist())) == 8
 
 
 def test_resume_refuses_other_model_format(tmp_path):
