@@ -88,6 +88,14 @@ class TrainConfig:
     """
 
     eval_split: float = 0.01
+    # Compute precision for the model's conv/matmul path (parameters,
+    # batch-norm statistics, the classifier head, and all acquisition math
+    # stay float32 — see models/resnet.py).  "auto" = bfloat16 on TPU,
+    # float32 elsewhere; the reference trains float32 everywhere
+    # (src/utils/get_networks.py:28-29 builds torch fp32 modules), but on
+    # TPU the MXU's native precision is bf16 and fp32 would halve
+    # throughput for no accuracy win at these model scales.
+    dtype: str = "auto"
     loader_tr: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     loader_te: LoaderConfig = dataclasses.field(
         default_factory=lambda: LoaderConfig(batch_size=100))
@@ -111,6 +119,16 @@ class TrainConfig:
     # cache_eval_bytes, falling back to per-epoch decode past the budget.
     cache_eval: bool = True
     cache_eval_bytes: int = 4 << 30
+    # Disk-memmap decode-once cache for the WHOLE deterministic pool view
+    # (al scoring + test set, data/cache.DecodedPoolCache): each row is
+    # JPEG-decoded exactly once per experiment lifetime instead of once
+    # per round/epoch, so steady-state ImageNet scoring is bounded by
+    # host->device bandwidth, not decode (bench r3: 1,048 img/s/core
+    # decode vs 3,133 img/s h2d vs 9,742 img/s device).  Applied only
+    # when the FULL pool fits the byte budget (sparse file; a partial
+    # cache would still thrash).  dir=None -> <tempdir>/al_tpu_decoded.
+    cache_decoded_bytes: int = 32 << 30
+    decoded_cache_dir: Optional[str] = None
     # Keep in-memory datasets resident on device (replicated) for the
     # whole experiment — ONE shared upload serves every round's
     # acquisition scoring AND the per-epoch validation/test evaluation
@@ -163,6 +181,9 @@ class ExperimentConfig:
     log_dir: str = "./logs"
     ckpt_path: str = "./checkpoint"
     enable_metrics: bool = True
+    # Comma-separated sink backends (utils/metrics.SINK_BACKENDS):
+    # "jsonl", "csv", "tensorboard", or combinations ("jsonl,tensorboard").
+    metrics_backend: str = "jsonl"
 
     # Dataset
     dataset: str = "cifar10"
@@ -192,6 +213,10 @@ class ExperimentConfig:
     debug_mode: bool = False
     # Capture an XLA profiler trace (TensorBoard/XProf) for the run.
     profile_dir: Optional[str] = None
+
+    # Compute-precision override: None defers to the arg pool's
+    # TrainConfig.dtype ("auto" = bf16 on TPU / f32 elsewhere).
+    dtype: Optional[str] = None
 
     # Coreset / BADGE partitioning (parser.py:74-79)
     subset_labeled: Optional[int] = None

@@ -31,6 +31,8 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_path", type=str, default="./checkpoint")
     p.add_argument("--disable_metrics", action="store_true",
                    help="replaces --enable_comet (metrics on by default)")
+    p.add_argument("--metrics_backend", type=str, default="jsonl",
+                   help="comma-separated sinks: jsonl, csv, tensorboard")
     # Dataset (parser.py:27-39)
     p.add_argument("--dataset", type=str, default="cifar10",
                    choices=["cifar10", "imbalanced_cifar10", "imagenet",
@@ -63,6 +65,12 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug_mode", action="store_true")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="capture an XLA profiler trace to this directory")
+    # Compute precision (TPU-specific; the reference is fp32-only,
+    # get_networks.py:28-29).  Default defers to the arg pool's
+    # TrainConfig.dtype, whose "auto" means bf16 on TPU / f32 elsewhere.
+    p.add_argument("--dtype", type=str, default=None,
+                   choices=["auto", "bfloat16", "float32"],
+                   help="model compute precision (params/BN stay float32)")
     # Coreset / BADGE scale controls (parser.py:74-79)
     p.add_argument("--subset_labeled", type=int, default=None)
     p.add_argument("--subset_unlabeled", type=int, default=None)
@@ -96,6 +104,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         log_dir=args.log_dir,
         ckpt_path=args.ckpt_path,
         enable_metrics=not args.disable_metrics,
+        metrics_backend=args.metrics_backend,
         dataset=args.dataset,
         dataset_dir=args.dataset_dir,
         arg_pool=args.arg_pool,
@@ -116,6 +125,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         early_stop_patience=args.early_stop_patience,
         debug_mode=args.debug_mode,
         profile_dir=args.profile_dir,
+        dtype=args.dtype,
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,
         partitions=args.partitions,

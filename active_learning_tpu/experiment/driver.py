@@ -20,6 +20,7 @@ metric names (main_al.py:24-40).
 
 from __future__ import annotations
 
+import os
 import uuid
 from datetime import date
 from typing import Optional, Tuple
@@ -73,12 +74,36 @@ def build_experiment(
                         debug_mode=cfg.debug_mode,
                         imbalance_args=imbalance_args)
     train_set, test_set, al_set = data
+    # Disk datasets with deterministic views get the experiment-lifetime
+    # decode-once memmap cache: every acquisition round re-scores the full
+    # pool and every round re-evaluates the full test set, so decode —
+    # ~30x slower than device scoring on ImageNet trees — must be paid
+    # once, not per round (data/cache.DecodedPoolCache).
+    from ..data.cache import DecodedPoolCache, maybe_wrap_decoded
+
+    # Default under ~/.cache, NOT tempfile.gettempdir(): /tmp is commonly
+    # tmpfs, where a multi-GB "disk" memmap would silently consume host
+    # RAM past every configured RAM budget.
+    cache_dir = (train_cfg.decoded_cache_dir
+                 or os.path.join(os.path.expanduser("~"), ".cache",
+                                 "al_tpu_decoded"))
+    budget = train_cfg.cache_decoded_bytes
+    al_set = maybe_wrap_decoded(al_set, cache_dir, budget)
+    if isinstance(al_set, DecodedPoolCache):
+        # One byte budget bounds the DIRECTORY, not each wrap: the test
+        # set only caches into what the al pool left.
+        budget -= len(al_set) * int(np.prod(al_set.image_shape))
+    if test_set is not None:
+        test_set = maybe_wrap_decoded(test_set, cache_dir, budget)
     num_classes = al_set.num_classes
 
     if model is None:
+        # --dtype beats the arg pool's TrainConfig.dtype; "auto" lands on
+        # bfloat16 when the live backend is TPU (models/factory.py).
         model = get_network(cfg.dataset, cfg.model,
                             freeze_feature=cfg.freeze_feature,
-                            num_classes=num_classes)
+                            num_classes=num_classes,
+                            dtype=cfg.dtype or train_cfg.dtype)
     if mesh is None:
         mesh = mesh_lib.make_mesh(cfg.num_devices)
     trainer = Trainer(model, train_cfg, mesh, num_classes)
@@ -159,7 +184,8 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
                else cfg.exp_hash)
         # Metrics/assets are run-level side effects: process 0 only.
         sink = make_sink(cfg.enable_metrics and mesh_lib.is_coordinator(),
-                         cfg.log_dir, experiment_key=key)
+                         cfg.log_dir, experiment_key=key,
+                         backend=cfg.metrics_backend)
     strategy = build_experiment(cfg, sink=sink, data=data, mesh=mesh,
                                 train_cfg=train_cfg, model=model,
                                 skip_init_pool=resuming)

@@ -18,6 +18,32 @@ from .resnet import SSLClassifier, resnet18, resnet50
 MODELS.register("SSLResNet18", resnet18)
 MODELS.register("SSLResNet50", resnet50)
 
+# Compute-precision names accepted by configs/CLI.  "auto" resolves by the
+# live backend: the TPU MXU is bf16-native, everything else gets float32.
+_DTYPE_NAMES = {
+    "float32": jnp.float32, "f32": jnp.float32, "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+}
+
+
+def resolve_dtype(spec: Any) -> Any:
+    """Resolve a config dtype spec (name string, jnp dtype, or "auto") to
+    the jnp compute dtype.  Parameters and BN statistics stay float32
+    regardless — this only selects the conv/matmul precision
+    (models/resnet.py)."""
+    if spec is None or spec == "auto":
+        import jax
+        return (jnp.bfloat16 if jax.default_backend() == "tpu"
+                else jnp.float32)
+    if isinstance(spec, str):
+        try:
+            return _DTYPE_NAMES[spec.lower()]
+        except KeyError:
+            raise ValueError(
+                f"Unknown dtype {spec!r}; expected one of "
+                f"{sorted(_DTYPE_NAMES)} or 'auto'")
+    return spec
+
 # Dataset -> class count (get_networks.py:3-6).
 DATASET_NUM_CLASSES = {
     "cifar10": 10,
@@ -33,7 +59,7 @@ def get_network(
     model_name: str,
     freeze_feature: bool = False,
     num_classes: Optional[int] = None,
-    dtype: Any = jnp.float32,
+    dtype: Any = "auto",
 ) -> SSLClassifier:
     if num_classes is None:
         try:
@@ -46,4 +72,4 @@ def get_network(
     # (resnet_simclr.py:17-18); keep that behavior.
     cifar_stem = num_classes == 10
     return factory(num_classes=num_classes, cifar_stem=cifar_stem,
-                   freeze_feature=freeze_feature, dtype=dtype)
+                   freeze_feature=freeze_feature, dtype=resolve_dtype(dtype))

@@ -369,11 +369,15 @@ class Trainer:
         same epoch loop (the reference overrides the whole
         parallel_train_fn, vaal_sampler.py:77-183)."""
         use_es = es_patience != 0 and len(eval_idxs) > 0
+        from ..data.cache import CachedEvalRows, DecodedPoolCache
         if (use_es and self.cfg.cache_eval and hasattr(al_set, "paths")
-                and not al_set.train_transform):
+                and not al_set.train_transform
+                and not isinstance(al_set, DecodedPoolCache)):
             # Disk-backed eval rows decode identically every epoch (the
             # val view is deterministic) — decode each once per round.
-            from ..data.cache import CachedEvalRows
+            # Skipped when the experiment-lifetime memmap cache already
+            # wraps the pool: rows then stream from the page cache and a
+            # second RAM copy buys nothing.
             al_set = CachedEvalRows(al_set,
                                     max_bytes=self.cfg.cache_eval_bytes)
         labels = train_set.targets[labeled_idxs]

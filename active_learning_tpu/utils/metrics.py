@@ -1,11 +1,20 @@
-"""Pluggable experiment-metrics sink.
+"""Pluggable experiment-metrics sinks.
 
 Replaces the reference's Comet ML integration (src/main_al.py:101-114 and the
 ``comet_experiment.log_metrics``/``log_asset_data`` calls threaded through
-``Strategy``) with a local JSONL sink that records the same metric schema:
+``Strategy``) with local sinks that record the same metric schema:
 ``cumulative_budget``, ``rd_test_accuracy``, ``budget_test_accuracy``,
 ``rd_{n}_validation_accuracy``, per-class accuracy assets, and queried-index
 assets (metric names documented at src/main_al.py:24-40).
+
+Backends (``--metrics_backend`` / ``ExperimentConfig.metrics_backend``):
+  * ``jsonl`` (default) — append-only event stream, trivially greppable.
+  * ``csv`` — one flat metrics.csv + assets/ directory; zero deps.
+  * ``tensorboard`` — event files via torch's SummaryWriter (the import is
+    lazy: it drags in TensorFlow and costs ~80 s, so only selecting the
+    backend pays it); per-round validation curves land as scalar series.
+Multiple backends compose with ``MultiSink`` (comma-separated on the CLI:
+``--metrics_backend jsonl,tensorboard``).
 """
 
 from __future__ import annotations
@@ -109,8 +118,141 @@ def _json_default(o: Any):
     return str(o)
 
 
+class CsvSink(MetricsSink):
+    """Flat ``metrics.csv`` (name, value, step, ts) + params.json +
+    assets/ files — for spreadsheet/pandas consumers; stdlib only."""
+
+    def __init__(self, directory: str, experiment_key: Optional[str] = None):
+        import csv
+
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        os.makedirs(os.path.join(directory, "assets"), exist_ok=True)
+        self.experiment_key = experiment_key or uuid.uuid4().hex[:9]
+        path = os.path.join(directory, "metrics.csv")
+        new = not os.path.exists(path)
+        self._fh = open(path, "a", newline="")
+        self._writer = csv.writer(self._fh)
+        if new:
+            self._writer.writerow(["name", "value", "step", "ts"])
+
+    def log_parameters(self, params):
+        with open(os.path.join(self.directory, "params.json"), "w") as fh:
+            json.dump(params, fh, indent=1, default=_json_default)
+
+    def log_metrics(self, metrics, step=None):
+        ts = time.time()
+        for name, value in metrics.items():
+            self._writer.writerow([name, _to_float(value), step, ts])
+        self._fh.flush()
+
+    def log_asset(self, name, data):
+        with open(os.path.join(self.directory, "assets", f"{name}.txt"),
+                  "w") as fh:
+            fh.write(data)
+
+    def close(self):
+        self._fh.close()
+
+
+class TensorBoardSink(MetricsSink):
+    """TensorBoard event files under ``directory/tb`` (the reference's
+    Comet charts, viewable with ``tensorboard --logdir``).  Scalars map
+    1:1 to the metric schema; params go through add_hparams-style text;
+    assets stay plain files (TensorBoard has no asset concept)."""
+
+    def __init__(self, directory: str, experiment_key: Optional[str] = None):
+        # Deliberately eager-in-constructor, lazy-at-module: importing
+        # SummaryWriter loads TensorFlow (~80 s in this image), a cost
+        # only runs that chose this backend should pay.
+        from torch.utils.tensorboard import SummaryWriter
+
+        self.directory = directory
+        os.makedirs(os.path.join(directory, "assets"), exist_ok=True)
+        self.experiment_key = experiment_key or uuid.uuid4().hex[:9]
+        self._writer = SummaryWriter(
+            os.path.join(directory, "tb", self.experiment_key))
+        self._auto_step = 0
+
+    def log_parameters(self, params):
+        text = "\n".join(f"    {k}: {v}" for k, v in sorted(params.items()))
+        self._writer.add_text("parameters", text)
+
+    def log_metrics(self, metrics, step=None):
+        if step is None:
+            self._auto_step += 1
+        for name, value in metrics.items():
+            self._writer.add_scalar(
+                name, _to_float(value),
+                global_step=self._auto_step if step is None else step)
+        self._writer.flush()
+
+    def log_asset(self, name, data):
+        with open(os.path.join(self.directory, "assets", f"{name}.txt"),
+                  "w") as fh:
+            fh.write(data)
+
+    def close(self):
+        self._writer.close()
+
+
+class MultiSink(MetricsSink):
+    """Fan out every event to several sinks (e.g. jsonl + tensorboard)."""
+
+    def __init__(self, sinks):
+        self.sinks = list(sinks)
+        self.experiment_key = (self.sinks[0].experiment_key
+                               if self.sinks else uuid.uuid4().hex[:9])
+
+    def log_parameters(self, params):
+        for s in self.sinks:
+            s.log_parameters(params)
+
+    def log_metrics(self, metrics, step=None):
+        for s in self.sinks:
+            s.log_metrics(metrics, step=step)
+
+    def log_asset(self, name, data):
+        for s in self.sinks:
+            s.log_asset(name, data)
+
+    def close(self):
+        for s in self.sinks:
+            s.close()
+
+
+SINK_BACKENDS = {
+    "jsonl": JsonlSink,
+    "csv": CsvSink,
+    "tensorboard": TensorBoardSink,
+}
+
+
 def make_sink(enable: bool, directory: str,
-              experiment_key: Optional[str] = None) -> MetricsSink:
+              experiment_key: Optional[str] = None,
+              backend: str = "jsonl") -> MetricsSink:
+    """Build the configured sink(s); ``backend`` is a comma-separated list
+    of SINK_BACKENDS names (unknown names raise — a typo must not
+    silently drop an experiment's metrics)."""
     if not enable:
         return NullSink()
-    return JsonlSink(directory, experiment_key=experiment_key)
+    names = [b.strip() for b in backend.split(",") if b.strip()]
+    if not names:
+        # Metrics are ON; an empty spec (templating artifact, "" or ",")
+        # silently becoming a NullSink is exactly the dropped-metrics
+        # failure the unknown-name error exists to prevent.
+        raise ValueError(
+            "metrics enabled but metrics_backend is empty; pass one of "
+            f"{sorted(SINK_BACKENDS)} or disable metrics explicitly")
+    sinks = []
+    for name in names:
+        try:
+            cls = SINK_BACKENDS[name]
+        except KeyError:
+            raise ValueError(
+                f"Unknown metrics backend {name!r}; expected one of "
+                f"{sorted(SINK_BACKENDS)}") from None
+        sinks.append(cls(directory, experiment_key=experiment_key))
+    if len(sinks) == 1:
+        return sinks[0]
+    return MultiSink(sinks)

@@ -287,3 +287,132 @@ class TestNativeDecode:
         assert ds._use_native  # one odd file must not kill the fast path
         # The CMYK slot decoded through PIL is not all zeros.
         assert all(out[i].any() for i in range(4))
+
+
+class TestDecodedPoolCache:
+    """Experiment-lifetime memmap decode cache (data/cache.DecodedPoolCache):
+    exact rows, decode-once-ever semantics, persistence across instances,
+    torn-write safety, and the eligibility gates of maybe_wrap_decoded."""
+
+    def test_rows_exact_and_decoded_once_across_instances(self, jpeg_tree,
+                                                          tmp_path):
+        from active_learning_tpu.data.cache import (DecodedPoolCache,
+                                                    maybe_wrap_decoded)
+        ds = make_ds(jpeg_tree, train=False)
+        want = ds.gather(np.arange(len(ds)))
+
+        calls = []
+        real_gather = ds.gather
+
+        def counting(idxs):
+            calls.append(np.asarray(idxs))
+            return real_gather(idxs)
+
+        ds.gather = counting
+        cached = maybe_wrap_decoded(ds, str(tmp_path), 1 << 30)
+        assert isinstance(cached, DecodedPoolCache)
+        out1 = cached.gather(np.asarray([3, 1, 3]))
+        np.testing.assert_array_equal(out1, want[[3, 1, 3]])
+        out2 = cached.gather(np.arange(len(ds)))
+        np.testing.assert_array_equal(out2, want)
+        decoded = np.concatenate(calls)
+        assert len(decoded) == len(np.unique(decoded)) == len(ds)
+
+        # A second instance over the same tree (fresh process in real
+        # life) must reuse the file: zero further decodes.
+        calls.clear()
+        cached2 = maybe_wrap_decoded(ds, str(tmp_path), 1 << 30)
+        np.testing.assert_array_equal(cached2.gather(np.arange(len(ds))),
+                                      want)
+        assert calls == []
+
+    def test_torn_write_not_served(self, jpeg_tree, tmp_path):
+        """A row whose bytes landed but whose valid flag did not (crash
+        between the two) must be re-decoded, and vice versa a zeroed row
+        with no flag never surfaces."""
+        from active_learning_tpu.data.cache import DecodedPoolCache
+        ds = make_ds(jpeg_tree, train=False)
+        cached = DecodedPoolCache(ds, str(tmp_path))
+        want = ds.gather(np.asarray([0]))[0]
+        cached.gather(np.asarray([0]))
+        # Simulate the torn state: flag cleared after a "crash".
+        cached._valid[0] = 0
+        cached._rows[0] = 0
+        np.testing.assert_array_equal(cached.gather(np.asarray([0]))[0],
+                                      want)
+
+    def test_eligibility_gates(self, jpeg_tree, tmp_path):
+        from active_learning_tpu.data.cache import maybe_wrap_decoded
+        val_ds = make_ds(jpeg_tree, train=False)
+        # Train views (non-deterministic crops) must never be wrapped.
+        train_ds = make_ds(jpeg_tree, train=True)
+        assert maybe_wrap_decoded(train_ds, str(tmp_path), 1 << 30) \
+            is train_ds
+        # A pool larger than the budget stays unwrapped (partial caches
+        # thrash; the scoring pass touches every row).
+        assert maybe_wrap_decoded(val_ds, str(tmp_path), 10) is val_ds
+        # In-memory datasets have no paths: unwrapped.
+        arr_ds = get_data_synthetic(n_train=8, n_test=4)[2]
+        assert maybe_wrap_decoded(arr_ds, str(tmp_path), 1 << 30) is arr_ds
+        # Disabled dir/budget: unwrapped.
+        assert maybe_wrap_decoded(val_ds, None, 1 << 30) is val_ds
+        assert maybe_wrap_decoded(val_ds, str(tmp_path), 0) is val_ds
+
+    def test_driver_wraps_disk_pool_and_scoring_uses_it(self, jpeg_tree,
+                                                        tmp_path):
+        """build_experiment must hand the strategy a cache-wrapped al/test
+        set for disk datasets, and the sampler's scoring pass must flow
+        through it (attribute passthrough intact)."""
+        import dataclasses
+
+        from active_learning_tpu.config import ExperimentConfig
+        from active_learning_tpu.data.cache import DecodedPoolCache
+        from active_learning_tpu.experiment.driver import build_experiment
+        from helpers import tiny_train_config
+
+        train_ds = make_ds(jpeg_tree, train=True)
+        al_ds = make_ds(jpeg_tree, train=False)
+        test_ds = make_ds(jpeg_tree, train=False)
+        train_cfg = dataclasses.replace(
+            tiny_train_config(), decoded_cache_dir=str(tmp_path / "cache"))
+        cfg = ExperimentConfig(
+            dataset="imagenet", strategy="MarginSampler", rounds=1,
+            round_budget=4, init_pool_size=4, n_epoch=1, exp_hash="t",
+            enable_metrics=False,
+            log_dir=str(tmp_path / "logs"), ckpt_path=str(tmp_path / "ck"))
+        strategy = build_experiment(cfg, data=(train_ds, test_ds, al_ds),
+                                    train_cfg=train_cfg)
+        strategy.init_network_weights()
+        assert isinstance(strategy.al_set, DecodedPoolCache)
+        assert isinstance(strategy.test_set, DecodedPoolCache)
+        assert strategy.train_set is train_ds  # train view never cached
+        assert strategy.al_set.num_classes == al_ds.num_classes
+        got, cost = strategy.query(4)
+        assert cost == 4 and len(got) == 4
+        # The query populated the cache for exactly the scored rows.
+        assert int(np.count_nonzero(strategy.al_set._valid)) > 0
+
+    def test_stale_cache_eviction(self, jpeg_tree, tmp_path):
+        """Old cache triples must be LRU-evicted when a new cache would
+        push the directory past its byte budget; in-use and same-
+        signature files survive."""
+        import time as time_mod
+
+        from active_learning_tpu.data.cache import (DecodedPoolCache,
+                                                    maybe_wrap_decoded)
+        ds = make_ds(jpeg_tree, train=False)
+        full = len(ds) * int(np.prod(ds.image_shape))
+        # Plant a fake stale triple, old mtime, bigger than the slack.
+        stale = tmp_path / "decoded_deadbeef00000000_p0"
+        for ext in (".u8", ".valid", ".json"):
+            with open(str(stale) + ext, "wb") as fh:
+                fh.write(b"x" * 4096)
+        old = time_mod.time() - 1e6
+        for ext in (".u8", ".valid", ".json"):
+            os.utime(str(stale) + ext, (old, old))
+        DecodedPoolCache._IN_USE.clear()
+        cached = maybe_wrap_decoded(ds, str(tmp_path), full + 2048)
+        assert isinstance(cached, DecodedPoolCache)
+        assert not os.path.exists(str(stale) + ".u8")
+        # A second wrap (same signature, now in use) evicts nothing.
+        assert os.path.exists(cached._data_path)

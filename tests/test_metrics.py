@@ -1,0 +1,86 @@
+"""Pluggable metrics sinks (SURVEY §5): the JSONL default plus the second
+backend family (csv, tensorboard) behind one registry, composable via
+MultiSink — the reference offers exactly one hardwired backend (Comet,
+src/main_al.py:101-114)."""
+
+import csv
+import json
+import os
+
+import pytest
+
+from active_learning_tpu.utils.metrics import (CsvSink, JsonlSink, MultiSink,
+                                               NullSink, SINK_BACKENDS,
+                                               make_sink)
+
+
+def test_csv_sink_roundtrip(tmp_path):
+    sink = CsvSink(str(tmp_path), experiment_key="k1")
+    sink.log_parameters({"strategy": "MarginSampler", "rounds": 2})
+    sink.log_metric("rd_test_accuracy", 0.5, step=1)
+    sink.log_metrics({"a": 1.0, "b": 2.0}, step=3)
+    sink.log_asset("labeled_idxs_on_rd_0", "1,2,3")
+    sink.close()
+
+    with open(tmp_path / "metrics.csv") as fh:
+        rows = list(csv.DictReader(fh))
+    assert [(r["name"], float(r["value"]), r["step"]) for r in rows] == [
+        ("rd_test_accuracy", 0.5, "1"), ("a", 1.0, "3"), ("b", 2.0, "3")]
+    with open(tmp_path / "params.json") as fh:
+        assert json.load(fh)["strategy"] == "MarginSampler"
+    with open(tmp_path / "assets" / "labeled_idxs_on_rd_0.txt") as fh:
+        assert fh.read() == "1,2,3"
+
+
+def test_make_sink_registry(tmp_path):
+    assert isinstance(make_sink(False, str(tmp_path)), NullSink)
+    assert isinstance(make_sink(True, str(tmp_path)), JsonlSink)
+    assert isinstance(make_sink(True, str(tmp_path), backend="csv"), CsvSink)
+    multi = make_sink(True, str(tmp_path), backend="jsonl,csv",
+                      experiment_key="k2")
+    assert isinstance(multi, MultiSink)
+    assert multi.experiment_key == "k2"
+    with pytest.raises(ValueError, match="Unknown metrics backend"):
+        make_sink(True, str(tmp_path), backend="comet")
+
+
+def test_multi_sink_fans_out(tmp_path):
+    multi = make_sink(True, str(tmp_path), backend="jsonl,csv")
+    multi.log_metric("x", 1.5, step=0)
+    multi.log_asset("a", "data")
+    multi.close()
+    assert os.path.exists(tmp_path / "metrics.jsonl")
+    with open(tmp_path / "metrics.csv") as fh:
+        assert len(list(csv.DictReader(fh))) == 1
+
+
+def test_cli_threads_metrics_backend(tmp_path):
+    from active_learning_tpu.experiment import cli
+
+    ns = cli.get_parser().parse_args(
+        ["--dataset", "synthetic", "--metrics_backend", "csv"])
+    assert cli.args_to_config(ns).metrics_backend == "csv"
+
+
+@pytest.mark.slow
+def test_tensorboard_sink_writes_events(tmp_path):
+    # The SummaryWriter import drags in TensorFlow (~80 s cold) — slow tier.
+    pytest.importorskip("torch.utils.tensorboard")
+    sink = make_sink(True, str(tmp_path), backend="tensorboard",
+                     experiment_key="k3")
+    assert "tensorboard" in SINK_BACKENDS
+    sink.log_parameters({"rounds": 2})
+    sink.log_metric("rd_test_accuracy", 0.25, step=1)
+    sink.log_asset("idxs", "4,5")
+    sink.close()
+    tb_dir = tmp_path / "tb" / "k3"
+    assert any(f.startswith("events.out") for f in os.listdir(tb_dir))
+    with open(tmp_path / "assets" / "idxs.txt") as fh:
+        assert fh.read() == "4,5"
+
+
+def test_empty_backend_with_metrics_enabled_raises(tmp_path):
+    with pytest.raises(ValueError, match="metrics_backend is empty"):
+        make_sink(True, str(tmp_path), backend="")
+    with pytest.raises(ValueError, match="metrics_backend is empty"):
+        make_sink(True, str(tmp_path), backend=" , ")
