@@ -38,6 +38,9 @@ from ..utils.logging import get_logger
 
 STATE_FILE = "experiment_state.npz"
 META_FILE = "experiment_state.json"
+# Sampler-owned aux state (Strategy.aux_state_bytes — e.g. VAAL's
+# VAE/discriminator/optimizers), msgpack via flax.serialization.
+AUX_FILE = "aux_state.msgpack"
 
 
 def _state_dir(cfg: ExperimentConfig) -> str:
@@ -58,6 +61,16 @@ def save_experiment(strategy, cfg: ExperimentConfig) -> str:
     state_path = os.path.join(directory, STATE_FILE)
     np.savez(state_path + ".tmp.npz", **arrays)
     os.replace(state_path + ".tmp.npz", state_path)
+    aux_path = os.path.join(directory, AUX_FILE)
+    aux = strategy.aux_state_bytes()
+    if aux is not None:
+        with open(aux_path + ".tmp", "wb") as fh:
+            fh.write(aux)
+        os.replace(aux_path + ".tmp", aux_path)
+    elif os.path.exists(aux_path):
+        # A stale aux blob from an older round of a sampler that stopped
+        # producing one must not be restored later.
+        os.remove(aux_path)
     meta = {
         "round": int(strategy.round),
         "model_format": MODEL_FORMAT_VERSION,
@@ -138,6 +151,11 @@ def load_experiment(strategy, cfg: ExperimentConfig) -> int:
             strategy.state = strategy.trainer.init_state(
                 jax.random.PRNGKey(0), sample)
         strategy.load_best_ckpt()
+    aux_path = os.path.join(directory, AUX_FILE)
+    if os.path.exists(aux_path):
+        with open(aux_path, "rb") as fh:
+            strategy.restore_aux_state(fh.read())
+        logger.info("Restored sampler aux state (VAE/discriminator)")
     logger.info(f"Resuming experiment from round {prev_round + 1}")
     return prev_round + 1
 

@@ -12,11 +12,16 @@ matvec's output lane width is 1), so this kernel restructures the layout:
   * d_new and the running min fuse into the same pass — one read of XT,
     one read-modify of min_dist, nothing else touches HBM.
 
-The kernel is numerically identical to the XLA path (float32 MXU
-accumulation); tests/test_kcenter_pallas.py pins it against the plain
-jnp expression in interpret mode.  Wiring into kcenter_greedy stays
-opt-in (AL_TPU_KCENTER_PALLAS=1) until the TPU A/B in bench.py shows it
-faster on the target generation — see DESIGN.md §5.
+Equivalence to the XLA path is proven in INTERPRET mode
+(tests/test_kcenter_pallas.py pins the kernel against the plain jnp
+expression); on a real MXU the tiled accumulation order differs from
+XLA's matvec, so float32 rounding can differ in the last ulp and an
+exact argmax tie could flip a pick.  bench.py's A/B therefore also
+reports whether the on-TPU pick sequences match
+(``pallas_picks_match``).  Wiring into kcenter_greedy stays opt-in
+(AL_TPU_KCENTER_PALLAS=1) until that A/B shows it faster on the target
+generation — see DESIGN.md §5 — and the caller falls back to the XLA
+scan if the compiled kernel fails at runtime (strategies/kcenter.py).
 """
 
 from __future__ import annotations
@@ -26,14 +31,15 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-
-try:  # pltpu is present wherever jax is, but keep import-safe
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
+from jax.experimental.pallas import tpu as pltpu
 
 TILE_N = 512
 TILE_D = 512
+
+# Set by strategies/kcenter.py when the compiled kernel failed at runtime
+# and the XLA scan answered instead; bench.py's A/B checks it so a
+# fallback can never masquerade as a Pallas measurement.
+LAST_FALLBACK_ERROR = None
 
 
 def _update_kernel(sqn_idx_ref, v_ref, xt_ref, sqn_ref, min_ref, out_ref,
@@ -70,7 +76,7 @@ def min_dist_update(xt: jnp.ndarray, sqn: jnp.ndarray,
 
     grid = (n // TILE_N, d // TILE_D)
     kwargs = {}
-    if not interpret and pltpu is not None:
+    if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"))
     return pl.pallas_call(
@@ -85,8 +91,7 @@ def min_dist_update(xt: jnp.ndarray, sqn: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((1, TILE_N), jnp.float32)] if pltpu
-        else [],
+        scratch_shapes=[pltpu.VMEM((1, TILE_N), jnp.float32)],
         interpret=interpret,
         **kwargs,
     )(sqn_idx, v, xt, sqn, min_dist)

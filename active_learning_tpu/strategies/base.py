@@ -162,6 +162,19 @@ class Strategy:
         variables = ckpt_lib.load_variables(path, like=self.state.variables)
         self.state = self.trainer.replace_variables(self.state, variables)
 
+    # -- auxiliary round-level state (resume seam) ------------------------
+
+    def aux_state_bytes(self) -> Optional[bytes]:
+        """Serialized sampler-owned state beyond the pool/model (e.g.
+        VAAL's VAE+discriminator) for the round-level experiment save.
+        None = nothing to persist.  The reference keeps such state for
+        free by pickling the whole strategy object
+        (src/utils/resume_training.py:38-52)."""
+        return None
+
+    def restore_aux_state(self, data: bytes) -> None:
+        """Inverse of aux_state_bytes, called during experiment resume."""
+
     # -- the four verbs ---------------------------------------------------
 
     def query(self, budget: int) -> Tuple[np.ndarray, int]:

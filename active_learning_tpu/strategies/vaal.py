@@ -128,6 +128,26 @@ class VAALSampler(Strategy):
         self._init_key, sub = jax.random.split(self._init_key)
         self.vaal_state = self._init_vaal_state(sub)
 
+    # -- round-level resume (the reference gets this via whole-object
+    # pickle, resume_training.py:38-52; here the seam is explicit) --------
+
+    def aux_state_bytes(self):
+        if self.vaal_state is None:
+            return None
+        from flax import serialization
+        return serialization.to_bytes(
+            jax.tree.map(np.asarray, self.vaal_state))
+
+    def restore_aux_state(self, data: bytes) -> None:
+        from flax import serialization
+        # Template with the right treedef/shapes; its values are fully
+        # overwritten.  PRNGKey(0) here does NOT touch _init_key, so the
+        # restored key stream continues exactly as the uninterrupted run.
+        template = jax.tree.map(np.asarray,
+                                self._init_vaal_state(jax.random.PRNGKey(0)))
+        restored = serialization.from_bytes(template, data)
+        self.vaal_state = mesh_lib.replicate(restored, self.mesh)
+
     # -- the jitted co-training step --------------------------------------
 
     def _build_vaal_step(self):
@@ -283,6 +303,16 @@ class VAALSampler(Strategy):
         idxs = self.available_query_idxs(shuffle=False)
         if len(idxs) == 0:
             return idxs, 0
+        if self.vaal_state is None:
+            # Only reachable resuming a save that predates aux-state
+            # persistence: score with a fresh adversary rather than crash,
+            # but say so — this round's picks differ from an uninterrupted
+            # run's.
+            self.logger.warning(
+                "VAAL aux state missing from the resumed experiment; "
+                "initializing a fresh VAE/discriminator for this query")
+            self._init_key, sub = jax.random.split(self._init_key)
+            self.vaal_state = self._init_vaal_state(sub)
         variables = {"vae_params": self.vaal_state.vae_params,
                      "vae_stats": self.vaal_state.vae_stats,
                      "d_params": self.vaal_state.d_params}

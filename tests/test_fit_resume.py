@@ -251,3 +251,53 @@ def test_fit_state_from_other_model_format_is_discarded(tmp_path):
     meta["model_format"] = 1
     open(path + ".json", "w").write(json.dumps(meta))
     assert ckpt_lib.load_fit_state(path, 0) is None
+
+
+class TestHookedFitRestartSemantics:
+    """Fits driven through a batch_hook (VAAL's co-training seam) have
+    RESTART-the-round semantics, not epoch resume: the hook's state
+    (VAALState, the unlabeled-batch cursor) is outside the trainer's
+    fit-state schema, so a partial fit state must be neither written by
+    nor consumed into a hooked fit — recovery for those lives at the
+    round level (experiment resume + Strategy.aux_state_bytes)."""
+
+    def _fit(self, tmp_path, batch_hook, n_epoch=4):
+        train_set, _, al_set = get_data_synthetic(
+            n_train=64, n_test=16, num_classes=4, image_size=8, seed=11)
+        mesh = mesh_lib.make_mesh()
+        trainer = Trainer(BNClassifier(), tiny_train_config(batch_size=16),
+                          mesh, num_classes=4, train_bn=True,
+                          current_ckpt_every=1)
+        state = trainer.init_state(jax.random.PRNGKey(0),
+                                   train_set.gather(np.arange(2)))
+        paths = ckpt_lib.weight_paths(str(tmp_path), "t", "h", round_idx=1)
+        result = trainer.fit(
+            state, train_set, np.arange(48), al_set, np.arange(48, 64),
+            n_epoch=n_epoch, es_patience=10, rng=np.random.default_rng(7),
+            round_idx=1, weight_paths=paths, batch_hook=batch_hook)
+        return result, paths
+
+    def test_hooked_fit_writes_no_fit_state_and_ignores_one(self, tmp_path):
+        hook_calls = []
+
+        def hook(epoch, batch):
+            hook_calls.append(epoch)
+
+        # An unhooked crashed fit leaves an epoch-level state behind ...
+        plain, paths = self._fit(tmp_path, None, n_epoch=4)
+        ckpt_lib.save_fit_state(
+            paths["fit_state"], variables=plain.state.variables,
+            opt_state=plain.state.opt_state, step=plain.state.step,
+            epoch=3, round_idx=1, best_perf=plain.best_perf,
+            best_epoch=plain.best_epoch, es_count=0,
+            key=jax.random.PRNGKey(1), rng=np.random.default_rng(7))
+        assert ckpt_lib.load_fit_state(paths["fit_state"], 1) is not None
+
+        # ... but the hooked fit must start at epoch 1 (full restart, NOT
+        # epoch resume), run every epoch's hooks, and — having completed
+        # its round — clear the now-stale state like any finished fit.
+        hooked, _ = self._fit(tmp_path, hook, n_epoch=2)
+        assert hooked.epochs_run == 2
+        assert min(hook_calls) == 1  # restarted from the first epoch
+        assert len(hook_calls) == 2 * 3  # every epoch x 3 batches of 16/48
+        assert ckpt_lib.load_fit_state(paths["fit_state"], 1) is None

@@ -19,6 +19,10 @@ import sys
 import numpy as np
 import pytest
 
+# Spawns real 2-process jax.distributed runs (fresh interpreters, fresh
+# XLA compiles per process).
+pytestmark = pytest.mark.slow
+
 from active_learning_tpu.data.pipeline import gather_batch, padded_batch_layout
 from active_learning_tpu.data.synthetic import get_data_synthetic
 from active_learning_tpu.parallel import mesh as mesh_lib

@@ -17,6 +17,10 @@ converted checkpoint.
 import numpy as np
 import pytest
 
+# Differential torch-vs-Flax forwards compile both stacks at multiple
+# input shapes.
+pytestmark = pytest.mark.slow
+
 torch = pytest.importorskip("torch")
 import torch.nn as nn  # noqa: E402
 

@@ -3,6 +3,11 @@ dynamics, discriminator-score acquisition."""
 
 import jax
 import jax.numpy as jnp
+import pytest
+
+# ~200 s of XLA compiles (jitted VAE+discriminator co-step at several
+# shapes): the single biggest line in the suite's wall-clock.
+pytestmark = pytest.mark.slow
 import numpy as np
 
 from active_learning_tpu.models.vaal import (VAE, Discriminator,
@@ -121,3 +126,59 @@ class TestVAALTraining:
         s.train()
         got2, cost2 = s.query(8)
         assert not np.isin(got2, got).any()
+
+
+class TestVAALResume:
+    def test_round_resume_restores_adversary(self, tmp_path):
+        """Round-level resume must bring back the trained
+        VAE/discriminator (VERDICT r3 #7): the reference kept it for free
+        by pickling the whole strategy (resume_training.py:38-52); here
+        the explicit aux-state seam carries it, and a resumed experiment
+        must produce IDENTICAL discriminator scores to the interrupted
+        one."""
+        from active_learning_tpu.experiment import resume as resume_lib
+        from active_learning_tpu.strategies import scoring
+
+        s = make_vaal_strategy(n_epoch=1, ckpt_path=str(tmp_path))
+        s.train()
+        resume_lib.save_experiment(s, s.cfg)
+
+        def d_scores(strategy, idxs):
+            variables = {"vae_params": strategy.vaal_state.vae_params,
+                         "vae_stats": strategy.vaal_state.vae_stats,
+                         "d_params": strategy.vaal_state.d_params}
+            out = scoring.collect_pool(
+                strategy.al_set, idxs, strategy._score_batch_size(),
+                strategy._score_step, variables, strategy.mesh)
+            return np.asarray(out["d_score"])
+
+        idxs = s.available_query_idxs(shuffle=False)
+        want = d_scores(s, idxs)
+
+        # Fresh build = new process; its randomly-initialized adversary
+        # must NOT score like the trained one (the test must bite) ...
+        s2 = make_vaal_strategy(n_epoch=1, ckpt_path=str(tmp_path))
+        assert not np.allclose(d_scores(s2, idxs), want)
+
+        # ... and after load_experiment it must match bit for bit.
+        resume_lib.load_experiment(s2, s2.cfg)
+        for a, b in zip(jax.tree_util.tree_leaves(s.vaal_state),
+                        jax.tree_util.tree_leaves(s2.vaal_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(d_scores(s2, idxs), want)
+
+    def test_save_without_aux_state_leaves_no_file(self, tmp_path):
+        """Non-VAAL samplers persist no aux blob, and a stale one from an
+        earlier sampler is removed rather than resurrected."""
+        import os
+
+        from active_learning_tpu.experiment import resume as resume_lib
+
+        s = make_strategy("RandomSampler", ckpt_path=str(tmp_path))
+        d = resume_lib.save_experiment(s, s.cfg)
+        assert not os.path.exists(os.path.join(d, resume_lib.AUX_FILE))
+        # Plant a stale blob; the next save must delete it.
+        with open(os.path.join(d, resume_lib.AUX_FILE), "wb") as fh:
+            fh.write(b"stale")
+        resume_lib.save_experiment(s, s.cfg)
+        assert not os.path.exists(os.path.join(d, resume_lib.AUX_FILE))
