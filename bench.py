@@ -659,6 +659,68 @@ def _flops_per_step(jitted, phase: str, *args, **kwargs):
         return None
 
 
+def _time_loop(step_once, sync, iters: int, warmup: int = 3) -> float:
+    """The ONE timing discipline for every measured step — primary and
+    alt-batch, train and score: ``warmup`` untimed iterations, a
+    data-dependent host fetch (``sync``) so the device really finished,
+    then ``iters`` timed iterations closed by the same fetch
+    (block_until_ready can return early on remote-execution backends;
+    host fetches cannot)."""
+    for _ in range(warmup):
+        step_once()
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step_once()
+    sync()
+    return time.perf_counter() - t0
+
+
+def _train_runner(trainer, batch, state, n_classes, view, seed: int):
+    """(step_once, sync, holder) driving one train step per call; the
+    holder chains state/key so the final loss fetch is data-dependent on
+    every step."""
+    import jax
+    import jax.numpy as jnp
+
+    h = {"state": state, "key": jax.random.PRNGKey(seed), "loss": None}
+    cw = jnp.ones(n_classes, jnp.float32)
+    lr = jnp.float32(0.1)
+
+    def step_once():
+        h["key"], sub = jax.random.split(h["key"])
+        h["state"], h["loss"] = trainer._train_step(
+            h["state"], batch, sub, lr, cw, view=view)
+
+    return step_once, (lambda: float(h["loss"])), h
+
+
+def _score_runner(model, score_view, variables, batch):
+    """(step_once, sync, sstep, sbatch) for the scoring pass.  A scalar is
+    chained through every iteration INSIDE one jitted call so the final
+    host fetch is data-dependent on all of them with exactly one dispatch
+    per iteration — per-iteration eager ops (indexing + add) each cost a
+    full round-trip on a tunneled remote backend and can dwarf the
+    compute being measured."""
+    import jax
+    import jax.numpy as jnp
+    from active_learning_tpu.strategies import scoring
+
+    sbatch = {"image": batch["image"], "mask": batch["mask"]}
+    sstep = scoring.make_prob_stats_step(model, score_view)
+
+    @jax.jit
+    def chained(variables, batch, carry):
+        return carry + sstep(variables, batch)["margin"][0]
+
+    h = {"carry": jnp.float32(0.0)}
+
+    def step_once():
+        h["carry"] = chained(variables, sbatch, h["carry"])
+
+    return step_once, (lambda: float(h["carry"])), sstep, sbatch
+
+
 def run_child_phase(phase: str, iters: int, per_chip: int):
     """Yields the phase result dict, then — for train/score phases — the
     same result enriched with flops/MFU.  The caller prints each as its
@@ -691,54 +753,20 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
      state) = _phase_setup(config, batch_size)
 
     if kind == "train":
-        class_weights = jnp.ones(n_classes, jnp.float32)
-        lr = jnp.float32(0.1)
-        key = jax.random.PRNGKey(1)
-
-        def step(state, key):
-            key, sub = jax.random.split(key)
-            state, loss = trainer._train_step(state, batch, sub, lr,
-                                              class_weights, view=train_view)
-            return state, key, loss
-
-        for _ in range(3):
-            state, key, loss = step(state, key)
-        float(loss)  # host fetch — the device really finished warmup
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, key, loss = step(state, key)
-        float(loss)  # data-dependent on every step via the state chain
-        dt = time.perf_counter() - t0
+        step_once, sync, holder = _train_runner(trainer, batch, state,
+                                                n_classes, train_view, 1)
+        dt = _time_loop(step_once, sync, iters)
 
         def flops_fn():
-            return _flops_per_step(trainer._train_step, phase, state, batch,
-                                   key, lr, class_weights, view=train_view)
+            return _flops_per_step(
+                trainer._train_step, phase, holder["state"], batch,
+                holder["key"], jnp.float32(0.1),
+                jnp.ones(n_classes, jnp.float32), view=train_view)
     else:
-        from active_learning_tpu.strategies import scoring
-
-        sbatch = {"image": batch["image"], "mask": batch["mask"]}
-        sstep = scoring.make_prob_stats_step(model, score_view)
         variables = state.variables
-
-        # Chain a scalar through every iteration INSIDE one jitted call so
-        # the final host fetch is data-dependent on all of them, with
-        # exactly one dispatch per iteration — per-iteration eager ops
-        # (indexing + add) each cost a full round-trip on a tunneled
-        # remote backend and can dwarf the compute being measured.
-        @jax.jit
-        def chained(variables, batch, carry):
-            out = sstep(variables, batch)
-            return carry + out["margin"][0]
-
-        carry = jnp.float32(0.0)
-        for _ in range(3):
-            carry = chained(variables, sbatch, carry)
-        float(carry)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            carry = chained(variables, sbatch, carry)
-        float(carry)
-        dt = time.perf_counter() - t0
+        step_once, sync, sstep, sbatch = _score_runner(
+            model, score_view, variables, batch)
+        dt = _time_loop(step_once, sync, iters)
 
         def flops_fn():
             return _flops_per_step(sstep, phase, variables, sbatch)
@@ -756,33 +784,26 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
     }
     yield dict(result)  # the measurement is safe with the parent now
 
-    if kind == "train" and jax.devices()[0].platform == "tpu":
+    if jax.devices()[0].platform == "tpu":
         # Batch-size lever for the MFU question (VERDICT r3 #4: train MFU
-        # 32% vs 39% scoring): measure the same step at 2x per-chip batch.
-        # Kept separate from the primary number so the series stays
-        # comparable across rounds.
+        # 32% vs 39% scoring, CIFAR scoring 26%): measure the same step at
+        # 2x per-chip batch.  Kept separate from the primary number so the
+        # series stays comparable across rounds.
         try:
             alt_pc = per_chip * 2
-            (_m2, _mod2, n_cls2, tv2, _sv2, trainer2, batch2,
+            (_m2, model2, n_cls2, tv2, sv2, trainer2, batch2,
              state2) = _phase_setup(config, alt_pc * n_chips)
-            cw2 = jnp.ones(n_cls2, jnp.float32)
-            key2 = jax.random.PRNGKey(2)
-            for _ in range(2):
-                key2, sub2 = jax.random.split(key2)
-                state2, loss2 = trainer2._train_step(
-                    state2, batch2, sub2, jnp.float32(0.1), cw2, view=tv2)
-            float(loss2)
             alt_iters = max(10, iters // 2)
-            t0 = time.perf_counter()
-            for _ in range(alt_iters):
-                key2, sub2 = jax.random.split(key2)
-                state2, loss2 = trainer2._train_step(
-                    state2, batch2, sub2, jnp.float32(0.1), cw2, view=tv2)
-            float(loss2)
-            alt_dt = time.perf_counter() - t0
+            if kind == "train":
+                alt_once, alt_sync, _h2 = _train_runner(
+                    trainer2, batch2, state2, n_cls2, tv2, 2)
+            else:
+                alt_once, alt_sync, _s2, _b2 = _score_runner(
+                    model2, sv2, state2.variables, batch2)
+            alt_dt = _time_loop(alt_once, alt_sync, alt_iters)
             result["alt_batch_per_chip"] = alt_pc
             result["alt_ips_per_chip"] = round(
-                alt_pc * n_chips * alt_iters / alt_dt / n_chips, 1)
+                alt_pc * alt_iters / alt_dt, 1)
             log(f"[{phase}] batch {alt_pc}/chip: "
                 f"{result['alt_ips_per_chip']:,.0f} img/s/chip "
                 f"(vs {result['ips_per_chip']:,.0f} at {per_chip})")
