@@ -129,6 +129,15 @@ class TrainConfig:
     # cache would still thrash).  dir=None -> <tempdir>/al_tpu_decoded.
     cache_decoded_bytes: int = 32 << 30
     decoded_cache_dir: Optional[str] = None
+    # Global batch for acquisition-scoring passes.  None = auto: the
+    # reference scores with its test-loader batch (100, e.g.
+    # src/arg_pools/default.py loader_te_args), which on an 8-chip mesh is
+    # ~12 rows per chip — far below MXU-efficient occupancy.  Auto keeps
+    # the reference batch on CPU (tests, parity) and raises it to at
+    # least 128 rows PER CHIP on accelerators.  Scores are per-example
+    # statistics under eval-mode BN, so the batch size changes throughput
+    # only, never a score.
+    score_batch_size: Optional[int] = None
     # Keep in-memory datasets resident on device (replicated) for the
     # whole experiment — ONE shared upload serves every round's
     # acquisition scoring AND the per-epoch validation/test evaluation

@@ -262,8 +262,16 @@ class Strategy:
     # -- scoring infrastructure -------------------------------------------
 
     def _score_batch_size(self) -> int:
-        return self.trainer.padded_batch_size(
-            self.train_cfg.loader_te.batch_size)
+        """Global scoring batch: explicit config wins; auto keeps the
+        reference's test-loader batch on CPU and raises it to >=128 rows
+        per chip on accelerators (see TrainConfig.score_batch_size —
+        scoring is per-example under eval BN, so this is throughput-only)."""
+        explicit = self.train_cfg.score_batch_size
+        if explicit:
+            return self.trainer.padded_batch_size(int(explicit))
+        # Auto: ONE policy with evaluation (Trainer.eval_batch_size) —
+        # the floor must never diverge between the two passes.
+        return self.trainer.padded_batch_size(self.trainer.eval_batch_size())
 
     def _get_score_step(self, kind: str) -> Callable:
         if kind not in self._score_steps:

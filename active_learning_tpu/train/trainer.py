@@ -117,6 +117,17 @@ class Trainer:
         n = self.n_devices
         return -(-batch_size // n) * n
 
+    def eval_batch_size(self) -> int:
+        """Global evaluation batch: the reference's test-loader batch (100)
+        on CPU, raised to >=128 rows per chip on accelerators — the eval
+        pass is per-example counts under eval-mode BN, so batch size is
+        throughput-only (same policy as acquisition scoring,
+        TrainConfig.score_batch_size)."""
+        bs = self.cfg.loader_te.batch_size
+        if self.mesh.devices.flat[0].platform != "cpu":
+            bs = max(bs, 128 * self.n_devices)
+        return bs
+
     def init_state(self, rng: jax.Array, sample_input: np.ndarray
                    ) -> TrainState:
         variables = self.model.init(rng, jnp.asarray(sample_input),
@@ -301,7 +312,7 @@ class Trainer:
         """Top-1/top-5/per-class metrics over ``dataset[idxs]``
         (replaces evaluation.py:11-105)."""
         eval_step = self._get_eval_step(dataset.view)
-        bs = self.padded_batch_size(self.cfg.loader_te.batch_size)
+        bs = self.padded_batch_size(self.eval_batch_size())
         variables = state.variables
 
         from ..parallel import resident as resident_lib

@@ -755,7 +755,6 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
     if kind == "train":
         step_once, sync, holder = _train_runner(trainer, batch, state,
                                                 n_classes, train_view, 1)
-        dt = _time_loop(step_once, sync, iters)
 
         def flops_fn():
             return _flops_per_step(
@@ -766,10 +765,28 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         variables = state.variables
         step_once, sync, sstep, sbatch = _score_runner(
             model, score_view, variables, batch)
-        dt = _time_loop(step_once, sync, iters)
 
         def flops_fn():
             return _flops_per_step(sstep, phase, variables, sbatch)
+
+    profile_dir = os.environ.get("AL_BENCH_PROFILE_DIR")
+    if profile_dir:
+        # XLA trace of the measured loop (VERDICT r3 #4, train AND score
+        # MFU): view with TensorBoard's profile plugin / XProf.  Warmup
+        # runs outside the trace so the capture is steady-state steps
+        # only.  Trace collection adds overhead to the timed loop, so the
+        # result is tagged "profiled" and the parent keeps it OUT of the
+        # cross-round cache.
+        _time_loop(step_once, sync, 0, warmup=3)
+        jax.profiler.start_trace(os.path.join(profile_dir, phase))
+        try:
+            dt = _time_loop(step_once, sync, iters, warmup=0)
+        finally:
+            jax.profiler.stop_trace()
+        log(f"[{phase}] profiler trace written to "
+            f"{os.path.join(profile_dir, phase)}")
+    else:
+        dt = _time_loop(step_once, sync, iters)
 
     ips = batch_size * iters / dt
     result = {
@@ -782,6 +799,8 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
     }
+    if profile_dir:
+        result["profiled"] = True  # trace overhead in dt: never cached
     yield dict(result)  # the measurement is safe with the parent now
 
     if jax.devices()[0].platform == "tpu":
@@ -1153,10 +1172,11 @@ def _main_inner() -> None:
             result["captured_utc"] = time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
             phases[name] = result
-            if not result.get("decode_only"):
-                # A decode-only CPU fallback is a degraded capture; it
-                # must never clobber a real accelerator entry in the
-                # cache (the cache exists to preserve those).
+            if not result.get("decode_only") and not result.get("profiled"):
+                # A decode-only CPU fallback is a degraded capture, and a
+                # profiled run's timings carry trace overhead; neither may
+                # clobber a clean accelerator entry in the cache (the
+                # cache exists to preserve those).
                 cache[name] = result
                 _save_cache(cache)
             log(f"[parent] {name}: {result['ips']:,.0f} img/s total, "

@@ -218,3 +218,41 @@ class TestBASE:
         got, cost = s.query(budget)
         assert cost == budget and np.unique(got).size == budget
         np.testing.assert_array_equal(got, np.asarray(expected))
+
+
+class TestScoreBatchSize:
+    """Acquisition-scoring batch policy (TrainConfig.score_batch_size):
+    the reference's test-loader batch (100) starves an accelerator mesh
+    at ~12 rows/chip, so auto raises it per chip off-CPU; scores are
+    per-example so only throughput can change."""
+
+    def test_auto_keeps_reference_batch_on_cpu(self):
+        s = make_strategy("MarginSampler")
+        want = s.trainer.padded_batch_size(s.train_cfg.loader_te.batch_size)
+        assert s._score_batch_size() == want
+
+    def test_explicit_override_wins(self):
+        import dataclasses
+        s = make_strategy("MarginSampler")
+        s.train_cfg = dataclasses.replace(s.train_cfg, score_batch_size=512)
+        assert s._score_batch_size() == s.trainer.padded_batch_size(512)
+
+    def test_accelerator_auto_floor_is_per_chip(self):
+        class FakeDev:
+            platform = "tpu"
+
+        class FakeMesh:
+            class devices:  # noqa: N801 — mimic np.ndarray .flat
+                flat = [FakeDev()]
+
+        s = make_strategy("MarginSampler")
+        # The auto branch delegates to Trainer.eval_batch_size (one
+        # policy for scoring and evaluation), which reads trainer.mesh.
+        real_mesh = s.trainer.mesh
+        s.trainer.mesh = FakeMesh()
+        try:
+            floor = 128 * s.trainer.n_devices
+            assert s._score_batch_size() == \
+                s.trainer.padded_batch_size(floor)
+        finally:
+            s.trainer.mesh = real_mesh

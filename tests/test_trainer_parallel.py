@@ -352,3 +352,32 @@ class TestResidentEvaluation:
                          np.arange(8))  # shares the images array
         assert calls["n"] == 0
         assert len(trainer.resident_pool["images"]) == 1  # one upload for both
+
+
+def test_eval_batch_floor_cpu_keeps_reference_batch():
+    """On the CPU test mesh, evaluation uses the reference's test-loader
+    batch unchanged; the accelerator floor (>=128 rows/chip) applies the
+    same throughput-only policy as acquisition scoring."""
+    from helpers import TinyClassifier, tiny_train_config
+    from active_learning_tpu.parallel import mesh as mesh_lib
+    from active_learning_tpu.train.trainer import Trainer
+
+    trainer = Trainer(TinyClassifier(num_classes=4),
+                      tiny_train_config(batch_size=16),
+                      mesh_lib.make_mesh(), num_classes=4)
+    assert trainer.eval_batch_size() == trainer.cfg.loader_te.batch_size
+
+    class FakeDev:
+        platform = "tpu"
+
+    class FakeMesh:
+        class devices:  # noqa: N801 — mimic np.ndarray .flat/.size
+            flat = [FakeDev()]
+            size = trainer.n_devices
+
+    real = trainer.mesh
+    trainer.mesh = FakeMesh()
+    try:
+        assert trainer.eval_batch_size() == 128 * trainer.n_devices
+    finally:
+        trainer.mesh = real
