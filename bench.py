@@ -1035,15 +1035,28 @@ def _finalize() -> dict:
                                 name, "not attempted"))
         if hw is None:
             phases[name]["device_unverified"] = True
+    for name, _, _, _ in PHASES:
+        if name not in phases:
+            # No fresh capture AND no cache: the phase must show up as an
+            # explicit failure, not silently vanish from the evidence.
+            # The cause names the backend only when the probe actually
+            # failed — mid-run partials on a healthy backend just have
+            # queued phases.
+            cause = ("not attempted (backend unreachable)"
+                     if _STATE["probe"] is not None and not probe.get("ok")
+                     else "not attempted")
+            failures.setdefault(name, f"{cause}; no cached entry")
 
     # Headline: the north-star model if captured, else the CIFAR model.
     headline = None
     for name in ("resnet50_imagenet_train", "resnet18_cifar_train",
                  "resnet50_imagenet_score", "resnet18_cifar_score",
                  "imagenet_datapath"):
-        # A decode-only datapath result is a host decode rate, not model
-        # throughput — never the headline.
-        if name in phases and not phases[name].get("decode_only"):
+        # A decode-only datapath result is a host decode rate and a
+        # profiled run's timings carry trace overhead — neither may be
+        # the headline.
+        if name in phases and not phases[name].get("decode_only") \
+                and not phases[name].get("profiled"):
             headline = name
             break
 
@@ -1230,7 +1243,10 @@ def _main_inner() -> None:
         if peak:
             entry["mfu"] = round(tflops_chip / peak, 3)
             entry["peak_tflops_per_chip"] = peak
-        if name in cache and not entry.get("decode_only"):
+        if name in cache and not entry.get("decode_only") \
+                and not entry.get("profiled"):
+            # Same rule as the capture loop: profiled timings never
+            # clobber a clean cache entry.
             cache[name] = {k: v for k, v in entry.items()
                            if k not in ("cached", "fresh_failure",
                                         "device_unverified")}
