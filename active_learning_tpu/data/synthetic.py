@@ -20,18 +20,23 @@ from .core import ArrayDataset, Normalization, ViewSpec
 SYNTH_NORM = Normalization((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
 
 
-def _make_images(n: int, num_classes: int, hw: int, rng: np.random.Generator
-                 ) -> Tuple[np.ndarray, np.ndarray]:
+def _class_templates(num_classes: int, hw: int, rng: np.random.Generator
+                     ) -> np.ndarray:
     # Class templates are SPATIALLY COARSE (a 4x4 color grid upsampled to
     # hw), not per-pixel noise: real images keep their identity under the
     # train view's random crop/flip, and so must these — a per-pixel
     # template decorrelates under a few pixels of shift, which silently
     # capped every augmented fit on this dataset at near-chance accuracy.
-    targets = rng.integers(0, num_classes, size=n)
     coarse = rng.uniform(40, 215, size=(num_classes, 4, 4, 3))
     reps = -(-hw // 4)
-    templates = np.repeat(np.repeat(coarse, reps, axis=1),
-                          reps, axis=2)[:, :hw, :hw, :]
+    return np.repeat(np.repeat(coarse, reps, axis=1),
+                     reps, axis=2)[:, :hw, :hw, :]
+
+
+def _make_images(n: int, templates: np.ndarray, rng: np.random.Generator
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    num_classes, hw = templates.shape[0], templates.shape[1]
+    targets = rng.integers(0, num_classes, size=n)
     noise = rng.normal(0, 25, size=(n, hw, hw, 3))
     images = np.clip(templates[targets] + noise, 0, 255).astype(np.uint8)
     return images, targets.astype(np.int64)
@@ -51,8 +56,13 @@ def get_data_synthetic(
     mirroring the reference's dataset-triple contract
     (src/data_utils/custom_cifar10.py:28-40)."""
     rng = np.random.default_rng(seed)
-    tr_images, tr_targets = _make_images(n_train, num_classes, image_size, rng)
-    te_images, te_targets = _make_images(n_test, num_classes, image_size, rng)
+    # ONE template set shared by train and test: each split drawing its
+    # own class definitions made the test set a different task — models
+    # that learned the train classes scored at or BELOW chance on test,
+    # silently, for every synthetic accuracy number.
+    templates = _class_templates(num_classes, image_size, rng)
+    tr_images, tr_targets = _make_images(n_train, templates, rng)
+    te_images, te_targets = _make_images(n_test, templates, rng)
     limit = 50 if debug_mode else None
 
     train_view = ViewSpec(SYNTH_NORM, augment=True, pad=4)

@@ -1,0 +1,65 @@
+"""End-to-end LEARNING check: a multi-round AL experiment must get
+measurably better at the task round over round.
+
+The mechanics suite proves the loop runs (pool grows, metrics emit,
+checkpoints land); the multichip dryrun proves one fit optimizes.  This
+pins the composite: query -> update -> re-init -> train -> test, three
+times, must raise test accuracy well above both chance and the round-0
+model — a regression anywhere in acquisition scoring, pool bookkeeping,
+checkpoint reload, or the train/eval loop shows up here as a flat curve.
+(The reference has no equivalent; its only end-to-end path is the
+--debug_mode smoke, src/utils/parser.py:70-71.)
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from active_learning_tpu.config import (ExperimentConfig, LoaderConfig,
+                                        OptimizerConfig, SchedulerConfig,
+                                        TrainConfig)
+from active_learning_tpu.data.synthetic import get_data_synthetic
+from active_learning_tpu.experiment.driver import run_experiment
+from active_learning_tpu.utils.metrics import NullSink
+
+from helpers import TinyClassifier
+
+pytestmark = pytest.mark.slow
+
+
+def test_accuracy_rises_across_rounds(tmp_path):
+    data = get_data_synthetic(n_train=1024, n_test=256, num_classes=4,
+                              image_size=16, seed=3)
+    train_cfg = TrainConfig(
+        eval_split=0.05,
+        loader_tr=LoaderConfig(batch_size=32),
+        loader_te=LoaderConfig(batch_size=64),
+        optimizer=OptimizerConfig(name="sgd", lr=0.05),
+        scheduler=SchedulerConfig(name="cosine", t_max=4),
+    )
+    cfg = ExperimentConfig(
+        dataset="synthetic", strategy="MarginSampler", rounds=3,
+        round_budget=96, init_pool_size=96, model="tiny", n_epoch=4,
+        early_stop_patience=0, exp_hash="curve",
+        log_dir=str(tmp_path / "logs"), ckpt_path=str(tmp_path / "ckpt"))
+
+    class CurveSink(NullSink):
+        experiment_key = "curve"
+
+        def __init__(self):
+            self.acc = {}
+
+        def log_metrics(self, metrics, step=None):
+            for k, v in metrics.items():
+                if k == "rd_test_accuracy":
+                    self.acc[int(step)] = float(v)
+
+    sink = CurveSink()
+    run_experiment(cfg, sink=sink, data=data, train_cfg=train_cfg,
+                   model=TinyClassifier(num_classes=4))
+    assert sorted(sink.acc) == [0, 1, 2]
+    # Labeled set triples from round 0 to round 2 (96 -> 288) on a
+    # trivially separable dataset: the final model must beat chance
+    # (0.25) decisively AND beat the round-0 model.
+    assert sink.acc[2] > 0.5, sink.acc
+    assert sink.acc[2] > sink.acc[0], sink.acc
