@@ -100,3 +100,30 @@ def make_strategy(name: str = "RandomSampler", n_train: int = 64,
         strategy.update(init_idxs, len(init_idxs))
     strategy.init_network_weights()
     return strategy
+
+
+def build_jpeg_tree(root: str, n_classes: int = 3, n_per_class: int = 6,
+                    seed: int = 0, min_hw: int = 40, max_hw: int = 80) -> str:
+    """Seeded class-per-subdirectory JPEG tree, built ATOMICALLY (written
+    to a sibling temp dir, then renamed into place) so an interrupted
+    build can never leave a partial tree that later runs silently reuse.
+    Shared by the pytest jpeg_tree fixture and the multihost worker."""
+    import os
+    import shutil
+
+    from PIL import Image
+
+    if os.path.isdir(root):
+        return root
+    tmp = root + ".building"
+    shutil.rmtree(tmp, ignore_errors=True)
+    rng = np.random.default_rng(seed)
+    for c in range(n_classes):
+        cdir = os.path.join(tmp, f"class{c}")
+        os.makedirs(cdir)
+        for i in range(n_per_class):
+            hw = int(rng.integers(min_hw, max_hw))
+            arr = rng.integers(0, 256, size=(hw, hw + 10, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(cdir, f"img{i}.jpg"))
+    os.rename(tmp, root)
+    return root

@@ -104,6 +104,57 @@ def main() -> None:
                                mesh_lib.replicate(np.int32(q), mesh))
         picks.append(q)
 
+    # Decoded-pool disk cache across processes: cache files are
+    # process-suffixed (no cross-process locking), each process decodes
+    # only its local rows, and scoring THROUGH the cache must equal
+    # scoring the raw disk dataset.  Only PIL's availability is optional
+    # (recorded as a skip reason); any other failure in this block is a
+    # real bug and must crash the worker loudly.
+    decoded_margin = None
+    decoded_skip = None
+    try:
+        from PIL import Image  # noqa: F401 — availability probe only
+    except ImportError:
+        decoded_skip = "PIL unavailable"
+    if decoded_skip is None:
+        from active_learning_tpu.data.cache import (DecodedPoolCache,
+                                                    maybe_wrap_decoded)
+        from active_learning_tpu.data.core import IMAGENET_NORM, ViewSpec
+        from active_learning_tpu.data.imagenet import ImageFolderDataset
+        from helpers import build_jpeg_tree
+        from jax.experimental import multihost_utils
+
+        # SHARED scratch (both workers' out paths live in one directory):
+        # process 0 writes the tree (atomic rename inside the builder —
+        # an interrupted manual run never leaves a reusable partial
+        # tree), the barrier publishes it to all.
+        scratch = os.path.join(os.path.dirname(os.path.abspath(out_path)),
+                               "mh_scratch")
+        tree = os.path.join(scratch, "tree")
+        if jax.process_index() == 0:
+            os.makedirs(scratch, exist_ok=True)
+            build_jpeg_tree(tree, n_classes=3, n_per_class=4, seed=9,
+                            min_hw=48, max_hw=56)
+        multihost_utils.sync_global_devices("jpeg_tree_built")
+        view = ViewSpec(IMAGENET_NORM, augment=False)
+        ds = ImageFolderDataset(tree, view, False, num_classes=3)
+        cached = maybe_wrap_decoded(ds, os.path.join(scratch, "dcache"),
+                                    1 << 30)
+        assert isinstance(cached, DecodedPoolCache)
+        assert cached._data_path.endswith(f"_p{jax.process_index()}.u8")
+        dmodel = TinyClassifier(num_classes=3)
+        dvars = dmodel.init(jax.random.PRNGKey(1),
+                            ds.gather(np.zeros(1, np.int64)), train=False)
+        dstep = scoring.make_prob_stats_step(dmodel, view)
+        raw = scoring.collect_pool(ds, np.arange(len(ds)), 4, dstep, dvars,
+                                   mesh)
+        warm = scoring.collect_pool(cached, np.arange(len(ds)), 4, dstep,
+                                    dvars, mesh)
+        np.testing.assert_allclose(np.asarray(warm["margin"]),
+                                   np.asarray(raw["margin"]),
+                                   rtol=1e-6, atol=1e-6)
+        decoded_margin = np.asarray(warm["margin"], np.float64).tolist()
+
     out = {
         "balancing_picks": picks,
         "process_index": jax.process_index(),
@@ -113,6 +164,8 @@ def main() -> None:
         "best_perf": float(result.best_perf),
         "param_sum": float(flat.sum()),
         "margin": np.asarray(scores["margin"], np.float64).tolist(),
+        "decoded_cache_margin": decoded_margin,
+        "decoded_cache_skip": decoded_skip,
     }
     with open(out_path, "w") as fh:
         json.dump(out, fh)

@@ -19,17 +19,9 @@ from active_learning_tpu.data.synthetic import get_data_synthetic
 
 @pytest.fixture(scope="module")
 def jpeg_tree(tmp_path_factory):
-    PIL = pytest.importorskip("PIL.Image")
-    root = tmp_path_factory.mktemp("imgs")
-    rng = np.random.default_rng(0)
-    for c in range(3):
-        cdir = root / f"class{c}"
-        os.makedirs(cdir)
-        for i in range(6):
-            hw = int(rng.integers(40, 80))
-            arr = rng.integers(0, 256, size=(hw, hw + 10, 3), dtype=np.uint8)
-            PIL.fromarray(arr).save(cdir / f"img{i}.jpg")
-    return str(root)
+    pytest.importorskip("PIL.Image")
+    from helpers import build_jpeg_tree
+    return build_jpeg_tree(str(tmp_path_factory.mktemp("imgs") / "tree"))
 
 
 def make_ds(jpeg_tree, train=True, seed=0):

@@ -138,6 +138,17 @@ class TestTwoProcessSmoke:
         # scores are global).
         assert by_pid[0]["param_sum"] == by_pid[1]["param_sum"]
         assert by_pid[0]["margin"] == by_pid[1]["margin"]
+        # Decoded-pool disk cache under jax.distributed: both processes
+        # scored through their own per-process cache files and the warm
+        # margins agreed with the raw dataset (asserted in-worker) AND
+        # across processes here.  A missing margin is only acceptable
+        # with an explicit skip reason (PIL absent) — any other failure
+        # already crashed the worker above.
+        if by_pid[0]["decoded_cache_margin"] is None:
+            assert by_pid[0]["decoded_cache_skip"], by_pid[0]
+        else:
+            assert by_pid[0]["decoded_cache_margin"] == \
+                by_pid[1]["decoded_cache_margin"]
 
         oracle_sum, oracle_margin = _single_process_oracle()
         assert by_pid[0]["param_sum"] == pytest.approx(oracle_sum, rel=1e-5)
