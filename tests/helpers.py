@@ -108,13 +108,26 @@ def build_jpeg_tree(root: str, n_classes: int = 3, n_per_class: int = 6,
     to a sibling temp dir, then renamed into place) so an interrupted
     build can never leave a partial tree that later runs silently reuse.
     Shared by the pytest jpeg_tree fixture and the multihost worker."""
+    import json
     import os
     import shutil
 
     from PIL import Image
 
+    # Reuse only a tree whose manifest matches EVERY build parameter: a
+    # persistent root (the worker's manual-recipe scratch lives in /tmp)
+    # must never hand back a tree built by older code after a param edit.
+    params = {"n_classes": n_classes, "n_per_class": n_per_class,
+              "seed": seed, "min_hw": min_hw, "max_hw": max_hw}
+    manifest = os.path.join(root, "manifest.json")
     if os.path.isdir(root):
-        return root
+        try:
+            with open(manifest) as fh:
+                if json.load(fh) == params:
+                    return root
+        except (OSError, json.JSONDecodeError):
+            pass
+        shutil.rmtree(root)
     tmp = root + ".building"
     shutil.rmtree(tmp, ignore_errors=True)
     rng = np.random.default_rng(seed)
@@ -125,5 +138,7 @@ def build_jpeg_tree(root: str, n_classes: int = 3, n_per_class: int = 6,
             hw = int(rng.integers(min_hw, max_hw))
             arr = rng.integers(0, 256, size=(hw, hw + 10, 3), dtype=np.uint8)
             Image.fromarray(arr).save(os.path.join(cdir, f"img{i}.jpg"))
+    with open(os.path.join(tmp, "manifest.json"), "w") as fh:
+        json.dump(params, fh)
     os.rename(tmp, root)
     return root
