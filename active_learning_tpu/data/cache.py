@@ -180,6 +180,20 @@ class DecodedPoolCache:
     def __len__(self) -> int:
         return len(self.dataset)
 
+    @property
+    def images(self):
+        """The decoded pool as one uint8 array — exposed ONLY once every
+        row is decoded.  A fully-populated cache thereby becomes eligible
+        for the device-resident paths (parallel/resident.py:eligible):
+        when ``resident_scoring_bytes`` covers the pool, rounds 1+ score
+        via on-device gathers instead of host->device streaming.  While
+        partial, AttributeError (falling through to the wrapped dataset,
+        which has no ``images``): a half-empty memmap must never be
+        uploaded as real data."""
+        if int(np.count_nonzero(self._valid)) != len(self.dataset):
+            raise AttributeError("decoded pool not fully populated")
+        return self._rows
+
     def __getattr__(self, name):
         # Only called for attributes NOT set on self: view/targets/paths/
         # image_shape/num_classes/train_transform all resolve through the
