@@ -448,8 +448,217 @@ class TestBenchEvidence:
                 "device_kind": "TPU v5 lite", "n_chips": 1}},
             probe={"ok": False, "error": "dead"})
         bench.PARTIAL_PATH = str(tmp_path / "partial.json")
+        bench.EVIDENCE_PATH = str(tmp_path / "evidence.json")
         bench._emit_final()
         line = capsys.readouterr().out.strip().splitlines()[-1]
         out = json.loads(line)
         assert out["value"] is None
         assert bench._STATE["emitted"]
+
+    def test_headline_skips_rateless_entry(self):
+        # ADVICE r4: a malformed entry without ips_per_chip used to win
+        # the headline slot, making value None and (with a V100 baseline)
+        # crashing the vs_baseline math — which degraded the output to
+        # the minimal error line, dropping every phase's evidence.
+        bench = self._bench_with_state(phases={
+            "resnet50_imagenet_train": {
+                "phase": "resnet50_imagenet_train", "n_chips": 1,
+                "device_kind": "TPU v5 lite"},  # no ips_per_chip
+            "resnet18_cifar_train":
+                self._entry("resnet18_cifar_train", ips_per_chip=3600.0),
+        })
+        out = bench._finalize()
+        assert out["metric"].startswith("resnet18_cifar_train")
+        assert out["value"] == 3600.0
+        assert out["vs_baseline"] == 2.0  # 3600 / the 1800 V100 envelope
+
+    def test_headline_skips_nan_rate(self):
+        # A stale cache file can carry a literal NaN (json.load accepts
+        # the token): such an entry must not win the headline over a
+        # phase holding a real number.
+        bench = self._bench_with_state(phases={
+            "resnet50_imagenet_train":
+                self._entry("resnet50_imagenet_train",
+                            ips_per_chip=float("nan")),
+            "resnet18_cifar_train":
+                self._entry("resnet18_cifar_train", ips_per_chip=1800.0),
+        })
+        out = bench._finalize()
+        assert out["metric"].startswith("resnet18_cifar_train")
+        assert out["value"] == 1800.0
+
+    def _full_entry(self, name):
+        # The optional fields each phase ACTUALLY produces, all at once —
+        # the realistic-maximal line must keep its rich form.
+        extra = dict(mfu=0.321, cached=True, fresh_failure="not attempted",
+                     device_unverified=True, tflops_per_sec_per_chip=77.6,
+                     peak_tflops_per_chip=197.0, gflop_per_image=7.97,
+                     flops_source="device-cost-analysis",
+                     batch_per_chip=128, iters=30, platform="tpu")
+        if name == "imagenet_datapath":
+            extra.update(ips_warm=9000.1, decode_ips=1047.8)
+        if name.startswith("al_round"):
+            extra.update(round_sec_warm=123.45, round_sec_cold=456.78,
+                         test_accuracy_rd1=0.8125,
+                         phases_sec={"round0": {"train_time": 100.0}})
+        if name == "kcenter_select":
+            extra.update(unit="picks/sec", pallas_speedup=1.23,
+                         pallas_picks_match=True)
+        return self._entry(name, **extra)
+
+    def test_compact_line_bounded_all_phases_full(self, capsys, tmp_path):
+        """Worst realistic case — every phase present with every optional
+        field it produces — must fit the driver's tail window in RICH
+        form, and the full evidence must land in the file the line
+        references."""
+        phases = {name: self._full_entry(name)
+                  for name, _, _, _ in
+                  self._bench_with_state().PHASES}
+        bench = self._bench_with_state(
+            phases=phases,
+            probe={"ok": True, "device_kind": "TPU v5 lite",
+                   "n_devices": 1, "platform": "tpu", "seconds": 5.0})
+        bench.PARTIAL_PATH = str(tmp_path / "partial.json")
+        bench.EVIDENCE_PATH = str(tmp_path / "evidence.json")
+        bench._emit_final(extra={"error": "x" * 400})
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert len(line.encode()) <= bench.MAX_LINE_BYTES
+        out = json.loads(line)
+        assert out["evidence"] == bench.EVIDENCE_PATH
+        assert out["phases"]["resnet50_imagenet_train"]["ips"] == 100.0
+        assert out["phases"]["al_round_cifar"]["warm_s"] == 123.45
+        assert out["phases"]["imagenet_datapath"]["warm_ips"] == 9000.1
+        # The file carries what the line dropped.
+        with open(bench.EVIDENCE_PATH) as fh:
+            full = json.load(fh)
+        assert full["phases"]["resnet50_imagenet_train"][
+            "tflops_per_sec_per_chip"] == 77.6
+
+    def test_compact_line_bounded_all_phases_failed(self, capsys, tmp_path):
+        """Opposite extreme — nothing captured, every phase failing with a
+        long message — must also fit and stay strictly parseable."""
+        failures = {name: "e" * 500 for name, _, _, _ in
+                    self._bench_with_state().PHASES}
+        bench = self._bench_with_state(
+            failures=failures, probe={"ok": False, "error": "p" * 300})
+        bench.PARTIAL_PATH = str(tmp_path / "partial.json")
+        bench.EVIDENCE_PATH = str(tmp_path / "evidence.json")
+        bench._emit_final()
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert len(line.encode()) <= bench.MAX_LINE_BYTES
+        out = json.loads(line)
+        assert out["value"] is None and not out["probe_ok"]
+        assert "al_round_cifar" in out["failed"]
+
+    def test_compact_line_degrades_on_adversarial_bloat(self, tmp_path):
+        """Even an impossible shape — every phase carrying every optional
+        field at once — stays under the bound via staged truncation."""
+        bench = self._bench_with_state()
+        entry = self._entry(
+            "x", mfu=0.3, unit="picks/sec", cached=True, ips_warm=1.0,
+            round_sec_warm=1.0, round_sec_cold=2.0, test_accuracy_rd1=0.5,
+            pallas_speedup=1.5)
+        out = {
+            "metric": "m" * 60, "value": 1.0, "unit": "u",
+            "vs_baseline": 1.0, "backend_probe": {"ok": True},
+            "elapsed_sec": 1.0, "error": "e" * 1000,
+            "phases": {f"phase_{i:02d}_{'n' * 20}": dict(entry)
+                       for i in range(12)},
+            "failed_phases": {f"fail_{i:02d}": "f" * 500
+                              for i in range(12)},
+        }
+        line = bench._compact_line(out)
+        assert len(line.encode()) <= bench.MAX_LINE_BYTES
+        parsed = json.loads(line)
+        assert parsed["evidence"] == bench.EVIDENCE_PATH
+
+    def test_finalize_crash_keeps_partial_and_recovers_it(self, capsys,
+                                                          tmp_path):
+        """A finalize crash at emit time must not clobber the last good
+        per-phase snapshot — it is recovered as the evidence body with
+        the error attached, and the partial mirror is left alone."""
+        bench = self._bench_with_state(
+            # A non-dict cache entry makes _finalize's dict(entry, ...)
+            # raise — the malformed-cache crash class.
+            cache={"resnet50_imagenet_train": "corrupt"},
+            probe={"ok": False, "error": "dead"})
+        bench.PARTIAL_PATH = str(tmp_path / "partial.json")
+        bench.EVIDENCE_PATH = str(tmp_path / "evidence.json")
+        bench._STATE["run_id"] = "this-run"
+        good = {"phases": {"resnet18_cifar_train":
+                           self._entry("resnet18_cifar_train")},
+                "partial": True, "run_id": "this-run"}
+        with open(bench.PARTIAL_PATH, "w") as fh:
+            json.dump(good, fh)
+        bench._emit_final()
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert "error" in out
+        # The snapshot survived in BOTH files.
+        with open(bench.PARTIAL_PATH) as fh:
+            assert json.load(fh) == good
+        with open(bench.EVIDENCE_PATH) as fh:
+            ev = json.load(fh)
+        assert ev["phases"]["resnet18_cifar_train"]["ips"] == 100.0
+        assert "finalize failed" in ev["error"]
+
+    def test_finalize_crash_never_adopts_other_runs_partial(self, capsys,
+                                                            tmp_path):
+        """A PREVIOUS run's snapshot (different run_id) must not be
+        presented as this run's evidence."""
+        bench = self._bench_with_state(
+            cache={"resnet50_imagenet_train": "corrupt"},
+            probe={"ok": False, "error": "dead"})
+        bench.PARTIAL_PATH = str(tmp_path / "partial.json")
+        bench.EVIDENCE_PATH = str(tmp_path / "evidence.json")
+        bench._STATE["run_id"] = "this-run"
+        stale = {"phases": {"resnet18_cifar_train":
+                            self._entry("resnet18_cifar_train")},
+                 "partial": True, "run_id": "previous-run", "value": 100.0}
+        with open(bench.PARTIAL_PATH, "w") as fh:
+            json.dump(stale, fh)
+        bench._emit_final()
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["value"] is None and "error" in out
+        with open(bench.EVIDENCE_PATH) as fh:
+            ev = json.load(fh)
+        assert "phases" not in ev  # the minimal dict, not the stale one
+        with open(bench.PARTIAL_PATH) as fh:
+            assert json.load(fh) == stale  # and the stale file untouched
+
+    def test_failed_evidence_write_nulls_the_path(self, capsys, tmp_path):
+        """If the evidence file cannot be written, the line must not point
+        at a stale previous file."""
+        bench = self._bench_with_state(
+            phases={"resnet18_cifar_train":
+                    self._entry("resnet18_cifar_train")})
+        bench.PARTIAL_PATH = str(tmp_path / "partial.json")
+        bench.EVIDENCE_PATH = str(tmp_path / "no_such_dir" / "evidence.json")
+        bench._emit_final()
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["evidence"] is None
+        assert out["value"] == 100.0  # the line itself still carries data
+
+    def test_nan_never_serialized(self, capsys, tmp_path):
+        """ADVICE r4: a NaN rate must serialize as null — the bare `NaN`
+        token is non-standard JSON and strict parsers reject the line."""
+        bench = self._bench_with_state(phases={
+            "resnet18_cifar_train":
+                self._entry("resnet18_cifar_train",
+                            ips=float("nan"), ips_per_chip=float("nan"),
+                            mfu=float("inf"))})
+        bench.PARTIAL_PATH = str(tmp_path / "partial.json")
+        bench.EVIDENCE_PATH = str(tmp_path / "evidence.json")
+        bench._emit_final()
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert "NaN" not in line and "Infinity" not in line
+
+        def reject(_):
+            raise AssertionError("non-standard JSON constant in line")
+
+        out = json.loads(line, parse_constant=reject)
+        assert out["phases"]["resnet18_cifar_train"]["ips"] is None
+        with open(bench.EVIDENCE_PATH) as fh:
+            json.load(fh, parse_constant=reject)
