@@ -322,7 +322,8 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
         return _datapath_model_passes(result, dataset, cached_set,
                                       batch_size, threads, mesh)
     finally:
-        # Pool-sized uint8 data must not squat in tempdir after the bench.
+        # Pool-sized uint8 data must not squat in persistent ~/.cache
+        # after the bench (and the next run's round 0 must start cold).
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
