@@ -225,6 +225,117 @@ def test_moco_checkpoint_full_pipeline(tmp_path):
         variables["params"]["linear"]["kernel"])
 
 
+def test_moco_v2_real_checkpoint_layout(tmp_path):
+    """Faithful facsimile of the ACTUAL paper input — MoCo-v2's published
+    ``moco_v2_800ep_pretrain.pth.tar`` (the file every ImageNet arg pool
+    names, reference ssp_finetuning.py:34 / ssp_linear_evaluation.py:21)
+    — pushed through ``apply_pretrained`` with the reference's EXACT key
+    filters.  The real file is the full training state main_moco.py
+    saves: ``{"epoch", "arch", "state_dict", "optimizer"}`` where
+    state_dict holds DistributedDataParallel-prefixed
+    ``module.encoder_q.*`` (ResNet-50, ImageNet stem, v2 MLP projection
+    head ``fc.0``/``fc.2``), a full momentum copy ``module.encoder_k.*``,
+    and the contrastive ``module.queue``/``queue_ptr`` buffers.
+
+    Asserts FULL overlay coverage: every key that survives the
+    reference's surgery must map into the Flax model (strict mode) and
+    every encoder leaf must actually be overwritten — a wrapper/naming
+    quirk that silently drops tensors is exactly what this test exists
+    to catch before paper-run time."""
+    from flax.traverse_util import flatten_dict
+
+    from active_learning_tpu.config import PretrainedConfig
+    from active_learning_tpu.utils.pretrained import (apply_pretrained,
+                                                      surgery,
+                                                      torch_key_to_flax)
+
+    tenc = TorchEncoder(TorchBottleneck, [3, 4, 6, 3], cifar_stem=False)
+    g = torch.Generator().manual_seed(11)
+    with torch.no_grad():
+        for p in tenc.parameters():
+            p.copy_(torch.randn(p.shape, generator=g) * 0.05)
+    tenc.train()
+    with torch.no_grad():
+        for _ in range(2):
+            tenc(torch.randn(4, 3, 64, 64, generator=g))
+    tenc.eval()
+
+    def moco_module(enc):
+        # One encoder as MoCo-v2 stores it: backbone + MLP head replacing
+        # torchvision's fc (main_moco.py builds fc = Sequential(Linear,
+        # ReLU, Linear); its state_dict keys are fc.0.* / fc.2.*).
+        sd = {k: v.clone() for k, v in enc.state_dict().items()}
+        sd["fc.0.weight"] = torch.randn(2048, 2048, generator=g)
+        sd["fc.0.bias"] = torch.randn(2048, generator=g)
+        sd["fc.2.weight"] = torch.randn(128, 2048, generator=g)
+        sd["fc.2.bias"] = torch.randn(128, generator=g)
+        return sd
+
+    state_dict = {}
+    for k, v in moco_module(tenc).items():
+        state_dict[f"module.encoder_q.{k}"] = v
+    for k, v in moco_module(tenc).items():
+        state_dict[f"module.encoder_k.{k}"] = v * 0.5
+    state_dict["module.queue"] = torch.randn(128, 65536, generator=g)
+    state_dict["module.queue_ptr"] = torch.zeros(1, dtype=torch.long)
+    path = str(tmp_path / "moco_v2_800ep_pretrain.pth.tar")
+    torch.save({"epoch": 800, "arch": "resnet50",
+                "state_dict": state_dict,
+                "optimizer": {"param_groups": []}}, path)
+
+    # The reference's exact filter config (ssp_finetuning.py:35-37).
+    cfg = PretrainedConfig(path=path, required_key=("encoder_q",),
+                           skip_key=("fc",),
+                           replace_key=(("encoder_q", "encoder"),))
+
+    # Coverage accounting BEFORE the overlay: after surgery, every
+    # surviving key must be encoder backbone state — each either maps to
+    # a Flax leaf or is a num_batches_tracked counter.  torch_key_to_flax
+    # raising KeyError on ANY of them fails the test.
+    survivors = surgery({k: v.numpy() for k, v in state_dict.items()},
+                        required_key=cfg.required_key,
+                        skip_key=cfg.skip_key, replace_map=cfg.replace_map)
+    assert all(k.startswith("encoder.") for k in survivors)
+    mapped = {k: torch_key_to_flax(k) for k in survivors}
+    n_counters = sum(1 for v in mapped.values() if v is None)
+    assert n_counters == 53  # one per BN layer in a ResNet-50
+    paths = [v[0] for v in mapped.values() if v is not None]
+    assert len(set(paths)) == len(paths)  # no two keys share one leaf
+
+    model = resnet50(num_classes=1000, cifar_stem=False)
+    x = np.random.default_rng(4).normal(size=(2, 3, 64, 64)
+                                        ).astype(np.float32)
+    variables = jax.tree.map(
+        np.asarray,
+        dict(model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(x.transpose(0, 2, 3, 1)),
+                        train=False)))
+    loaded = apply_pretrained(variables, cfg)
+
+    # Full coverage, verified on the RESULT: every encoder leaf (params
+    # AND batch_stats) was overwritten by a checkpoint tensor.
+    flat_init = flatten_dict(variables)
+    flat_loaded = flatten_dict(loaded)
+    enc_leaves = [p for p in flat_init if "encoder" in p]
+    assert len(enc_leaves) == len(mapped) - n_counters
+    untouched = [p for p in enc_leaves
+                 if np.array_equal(flat_loaded[p], flat_init[p])]
+    assert not untouched, f"leaves never overlaid: {untouched[:5]}"
+
+    # The converted encoder reproduces the torch encoder's embeddings.
+    with torch.no_grad():
+        want_emb = tenc(torch.from_numpy(x)).numpy()
+    _, got_emb = model.apply(loaded, jnp.asarray(x.transpose(0, 2, 3, 1)),
+                             train=False, return_features=True)
+    np.testing.assert_allclose(np.asarray(got_emb), want_emb,
+                               rtol=5e-4, atol=5e-4)
+    # And the classification head kept its random init bit-for-bit (the
+    # reference's partial-update semantics: fc was skipped).
+    np.testing.assert_array_equal(
+        loaded["params"]["linear"]["kernel"],
+        variables["params"]["linear"]["kernel"])
+
+
 def test_converter_strict_errors():
     """Unmappable keys and shape mismatches must raise, not silently
     skip — a wrong checkpoint going unnoticed is the failure mode the
