@@ -218,6 +218,10 @@ class ExperimentConfig:
     n_epoch: int = 60
     early_stop_patience: int = 30
 
+    # Self-provision disk datasets when absent (the reference's
+    # torchvision download=True, custom_cifar10.py:30-33).
+    download_data: bool = False
+
     # Debug
     debug_mode: bool = False
     # Capture an XLA profiler trace (TensorBoard/XProf) for the run.

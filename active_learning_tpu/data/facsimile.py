@@ -1,0 +1,85 @@
+"""Format-exact CIFAR-10 facsimile archives for offline validation.
+
+The sandbox has zero network egress, so the REAL data path — fetch ->
+md5 -> extract -> python-batch pickles -> ``get_data_cifar10`` — cannot
+be exercised against the canonical ``cifar-10-python.tar.gz``.  This
+module writes an archive that is byte-layout-faithful to it (same member
+names, same pickle schema: ``data`` as uint8 [N, 3072] row-major RGB
+planes, ``labels`` as a list, plus ``batches.meta``), with the images
+drawn from the learnable synthetic template dataset.  Everything the
+loader and the fetch path do to the real file, they do to this one; only
+the pixel content differs.
+
+Used by tests/test_data.py and by scripts/cifar10_evidence.py (the
+shortened-protocol evidence run, VERDICT r4 Missing #1/#4).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tarfile
+from typing import Tuple
+
+import numpy as np
+
+from .synthetic import _class_templates, _make_images
+
+LABEL_NAMES = ["airplane", "automobile", "bird", "cat", "deer",
+               "dog", "frog", "horse", "ship", "truck"]
+
+
+def write_cifar10_facsimile(path: str, n_train: int = 50000,
+                            n_test: int = 10000, seed: int = 77,
+                            noise_sigma: float = 25.0,
+                            contrast: float = 1.0
+                            ) -> Tuple[str, str]:
+    """Write ``cifar-10-python.tar.gz`` at ``path``; returns (path, md5).
+
+    ``n_train`` is split over five ``data_batch_*`` files exactly like
+    the canonical archive (10,000 rows each at full size).
+
+    ``noise_sigma``/``contrast`` set task difficulty (contrast scales the
+    class templates toward mid-grey).  At the synthetic defaults a linear
+    model saturates from the first batch of labels; evidence runs use
+    contrast ~0.06 with sigma ~60, calibrated (sklearn logistic
+    regression) to ~40% test accuracy at 1k labels rising to ~65% at 6k —
+    a curve that can actually show learning and strategy differences."""
+    rng = np.random.default_rng(seed)
+    templates = _class_templates(10, 32, rng)
+    templates = 127.5 + contrast * (templates - 127.5)
+
+    def batch_dict(n):
+        images, targets = _make_images(n, templates, rng,
+                                       noise_sigma=noise_sigma)
+        # HWC uint8 -> the archive's [N, 3072] R-plane/G-plane/B-plane
+        # rows (the inverse of the loader's reshape/transpose).
+        data = images.transpose(0, 3, 1, 2).reshape(n, -1)
+        return {"data": np.ascontiguousarray(data),
+                "labels": [int(t) for t in targets]}
+
+    per = -(-n_train // 5)
+    tmpdir = os.path.dirname(os.path.abspath(path))
+    os.makedirs(tmpdir, exist_ok=True)
+    members = []
+    left = n_train
+    for i in range(1, 6):
+        n = min(per, left)
+        left -= n
+        members.append((f"data_batch_{i}", batch_dict(n)))
+    members.append(("test_batch", batch_dict(n_test)))
+    members.append(("batches.meta",
+                    {"label_names": LABEL_NAMES,
+                     "num_cases_per_batch": per, "num_vis": 3072}))
+
+    with tarfile.open(path, "w:gz") as tar:
+        for name, obj in members:
+            blob = pickle.dumps(obj, protocol=2)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(blob)
+            import io
+            tar.addfile(info, io.BytesIO(blob))
+    with open(path, "rb") as fh:
+        md5 = hashlib.md5(fh.read()).hexdigest()
+    return path, md5

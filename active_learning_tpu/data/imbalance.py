@@ -55,13 +55,14 @@ def make_imbalanced(dataset: ArrayDataset, imbalance_type: Optional[str],
 
 
 def get_data_imbalanced_cifar10(data_path: str, debug_mode: bool = False,
-                                imbalance_args=None, **_unused):
+                                imbalance_args=None, download: bool = False,
+                                **_unused):
     """Imbalanced train/al over CIFAR-10 with a balanced test set
     (custom_imbalanced_cifar10.py:86-100)."""
     from .cifar10 import load_cifar10_arrays
 
     (tr_images, tr_targets), (te_images, te_targets) = load_cifar10_arrays(
-        data_path)
+        data_path, download=download)
     limit = 50 if debug_mode else None
     train_view = ViewSpec(CIFAR10_NORM, augment=True, pad=4)
     val_view = ViewSpec(CIFAR10_NORM, augment=False)

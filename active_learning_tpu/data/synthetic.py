@@ -33,11 +33,12 @@ def _class_templates(num_classes: int, hw: int, rng: np.random.Generator
                      reps, axis=2)[:, :hw, :hw, :]
 
 
-def _make_images(n: int, templates: np.ndarray, rng: np.random.Generator
+def _make_images(n: int, templates: np.ndarray, rng: np.random.Generator,
+                 noise_sigma: float = 25.0
                  ) -> Tuple[np.ndarray, np.ndarray]:
     num_classes, hw = templates.shape[0], templates.shape[1]
     targets = rng.integers(0, num_classes, size=n)
-    noise = rng.normal(0, 25, size=(n, hw, hw, 3))
+    noise = rng.normal(0, noise_sigma, size=(n, hw, hw, 3))
     images = np.clip(templates[targets] + noise, 0, 255).astype(np.uint8)
     return images, targets.astype(np.int64)
 

@@ -61,6 +61,9 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--n_epoch", type=int, default=60)
     p.add_argument("--early_stop_patience", type=int, default=30,
                    help="0 disables early stopping")
+    p.add_argument("--download_data", action="store_true",
+                   help="fetch CIFAR-10 (md5-verified) when absent — the "
+                        "reference's torchvision download=True")
     # Debug (parser.py:70-71)
     p.add_argument("--debug_mode", action="store_true")
     p.add_argument("--profile_dir", type=str, default=None,
@@ -123,6 +126,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         resume_training=args.resume_training,
         n_epoch=args.n_epoch,
         early_stop_patience=args.early_stop_patience,
+        download_data=args.download_data,
         debug_mode=args.debug_mode,
         profile_dir=args.profile_dir,
         dtype=args.dtype,

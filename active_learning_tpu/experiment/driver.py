@@ -72,7 +72,8 @@ def build_experiment(
         }
         data = get_data(cfg.dataset, data_path=cfg.dataset_dir,
                         debug_mode=cfg.debug_mode,
-                        imbalance_args=imbalance_args)
+                        imbalance_args=imbalance_args,
+                        download=cfg.download_data)
     train_set, test_set, al_set = data
     # Disk datasets with deterministic views get the experiment-lifetime
     # decode-once memmap cache: every acquisition round re-scores the full

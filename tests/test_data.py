@@ -162,3 +162,75 @@ def test_file_list_dataset(tmp_path):
     assert len(ds) == 4
     assert ds.targets.tolist() == [0, 1, 0, 1]
     assert ds.gather(np.array([2])).shape == (1, 224, 224, 3)
+
+
+class TestCifar10Fetch:
+    """The self-provisioning CIFAR-10 path (reference custom_cifar10.py:
+    30-33's torchvision download=True) against a byte-layout-faithful
+    facsimile archive served over file:// — everything but the pixel
+    content of the canonical tar.gz."""
+
+    @pytest.fixture()
+    def archive(self, tmp_path):
+        from active_learning_tpu.data.facsimile import write_cifar10_facsimile
+        path, md5 = write_cifar10_facsimile(
+            str(tmp_path / "cifar-10-python.tar.gz"),
+            n_train=250, n_test=50, seed=5)
+        return path, md5
+
+    def test_fetch_extract_load(self, archive, tmp_path):
+        from active_learning_tpu.data.cifar10 import (fetch_cifar10,
+                                                      load_cifar10_arrays)
+        path, md5 = archive
+        dest = str(tmp_path / "data")
+        root = fetch_cifar10(dest, url=f"file://{path}", expected_md5=md5)
+        assert root.endswith("cifar-10-batches-py")
+        (tr_im, tr_y), (te_im, te_y) = load_cifar10_arrays(dest)
+        assert tr_im.shape == (250, 32, 32, 3) and tr_im.dtype == np.uint8
+        assert te_im.shape == (50, 32, 32, 3)
+        assert set(np.unique(tr_y)) <= set(range(10))
+        # Idempotent: a second call must not re-download (dead URL).
+        assert fetch_cifar10(dest, url="file:///nonexistent") == root
+
+    def test_bad_md5_refuses_extraction(self, archive, tmp_path):
+        from active_learning_tpu.data.cifar10 import fetch_cifar10
+        path, _ = archive
+        dest = str(tmp_path / "data")
+        with pytest.raises(RuntimeError, match="md5"):
+            fetch_cifar10(dest, url=f"file://{path}", expected_md5="0" * 32)
+        assert not os.path.exists(os.path.join(dest,
+                                               "cifar-10-batches-py"))
+
+    def test_hostile_member_refused(self, tmp_path):
+        import io
+        import tarfile
+        from active_learning_tpu.data.cifar10 import fetch_cifar10
+        evil = str(tmp_path / "evil.tar.gz")
+        with tarfile.open(evil, "w:gz") as tar:
+            info = tarfile.TarInfo("../outside")
+            info.size = 1
+            tar.addfile(info, io.BytesIO(b"x"))
+        with pytest.raises(RuntimeError, match="suspicious"):
+            fetch_cifar10(str(tmp_path / "d"), url=f"file://{evil}",
+                          expected_md5=None)
+        assert not (tmp_path / "outside").exists()
+
+    def test_get_data_dispatch_with_download(self, archive, tmp_path,
+                                             monkeypatch):
+        """The full production dispatch: get_data('cifar10',
+        download=True) self-provisions from the (patched) canonical URL
+        and returns the reference's dataset triple."""
+        from active_learning_tpu.data import cifar10 as c10
+        path, md5 = archive
+        monkeypatch.setattr(c10, "CIFAR10_URL", f"file://{path}")
+        monkeypatch.setattr(c10, "CIFAR10_TGZ_MD5", md5)
+        train_set, test_set, al_set = get_data(
+            "cifar10", data_path=str(tmp_path / "data"), download=True)
+        assert len(train_set) == 250 and len(test_set) == 50
+        assert al_set.images is train_set.images  # shared storage
+        assert not al_set.view.augment and train_set.view.augment
+
+    def test_missing_without_download_mentions_flag(self, tmp_path):
+        from active_learning_tpu.data.cifar10 import find_cifar10_root
+        with pytest.raises(FileNotFoundError, match="download"):
+            find_cifar10_root(str(tmp_path / "nope"))
