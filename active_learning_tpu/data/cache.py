@@ -115,12 +115,17 @@ class DecodedPoolCache:
     # set legitimately share a directory): eviction must never take them.
     _IN_USE: set = set()
 
-    def __init__(self, dataset, cache_dir: str):
+    def __init__(self, dataset, cache_dir: str,
+                 signature: Optional[str] = None):
         self.dataset = dataset
         n = len(dataset)
         shape = (n, *dataset.image_shape)
         os.makedirs(cache_dir, exist_ok=True)
-        sig = self._signature(dataset)
+        # The signature stats every image file; callers that already
+        # computed it (maybe_wrap_decoded's eviction pass) hand it in so
+        # an ImageNet-scale tree pays the ~1.3M-stat sweep once, not
+        # twice.
+        sig = signature or self._signature(dataset)
         # Per-process files on pods: each process gathers only its own
         # rows; sharing one file over NFS would need row-range locking.
         proc = 0
@@ -263,9 +268,9 @@ def maybe_wrap_decoded(dataset, cache_dir: Optional[str],
             f"> budget {max_bytes / 1e9:.1f} GB")
         return dataset
     try:
-        _evict_stale_caches(cache_dir, full, max_bytes,
-                            keep_sig=DecodedPoolCache._signature(dataset))
-        return DecodedPoolCache(dataset, cache_dir)
+        sig = DecodedPoolCache._signature(dataset)
+        _evict_stale_caches(cache_dir, full, max_bytes, keep_sig=sig)
+        return DecodedPoolCache(dataset, cache_dir, signature=sig)
     except OSError as e:
         get_logger().warning(f"Decoded-pool cache unavailable ({e!r}); "
                              "continuing undecached")
