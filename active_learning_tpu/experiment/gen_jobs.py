@@ -82,8 +82,11 @@ def cifar10_experiments(dataset_dir: str, number_of_runs: int = 1,
     jobs = []
     for _, strategy, budget in product(range(number_of_runs),
                                        CIFAR_STRATEGIES, round_budgets):
+        # --download_data makes every CIFAR job one-command on a fresh
+        # machine (the reference gets this implicitly from torchvision
+        # download=True, custom_cifar10.py:30-33).
         jobs.append(
-            f"{CLI} --dataset_dir {dataset_dir} "
+            f"{CLI} --dataset_dir {dataset_dir} --download_data "
             f"--exp_name {strategy}_arg_{arg_pool}_{dataset}_b{budget} "
             f"--dataset {dataset} --arg_pool {arg_pool} "
             f"--n_epoch {n_epoch} --early_stop_patience 50 "
