@@ -281,6 +281,17 @@ class TestGenJobs:
         assert not missing, missing
         assert "--disable_metrics" in ours
 
+    def test_download_data_flag_reaches_config(self):
+        """--download_data (the reference's implicit torchvision
+        download=True) must plumb through to ExperimentConfig."""
+        from active_learning_tpu.experiment import cli
+
+        parser = cli.get_parser()
+        ns = parser.parse_args(["--dataset", "cifar10", "--download_data"])
+        assert cli.args_to_config(ns).download_data is True
+        ns = parser.parse_args(["--dataset", "cifar10"])
+        assert cli.args_to_config(ns).download_data is False
+
     def test_vaal_adversary_flag_uses_reference_spelling(self):
         """Published VAAL commands use --vaal_adversary_param
         (reference parser.py:84); both that and the short alias must
