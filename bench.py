@@ -530,10 +530,15 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         def log_asset(self, name, data):
             pass
 
-    # Smoke scale (CI / CPU): 1/25 of everything so the phase's full code
-    # path — driver, sink capture, both dataset kinds — runs in seconds.
+    # Smoke scale (CI / CPU): shrunk so the phase's full code path —
+    # driver, sink capture, both dataset kinds — runs on a single CPU
+    # core.  ImageNet smoke is far smaller than CIFAR smoke because every
+    # forward is ResNet-50 at 224px (~3-5 img/s on one core).
     smoke = os.environ.get("AL_BENCH_ROUND_SMOKE") == "1"
-    pool_n, test_n = (2000, 500) if smoke else (50000, 10000)
+    if smoke:
+        pool_n, test_n = (2000, 500) if config == "cifar" else (320, 96)
+    else:
+        pool_n, test_n = 50000, 10000
     if config == "cifar":
         from active_learning_tpu.data.synthetic import get_data_synthetic
         data = get_data_synthetic(n_train=pool_n, n_test=test_n)
@@ -554,7 +559,7 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         data = (train_set, test_set, al_set)
         train_cfg = get_train_config("default", "imagenet")
         dataset, model_name = "imagenet", "SSLResNet50"
-        budget = 40 if smoke else 2000
+        budget = 16 if smoke else 2000
 
     tmp = tempfile.mkdtemp(prefix="al_bench_round_")
     sink = CaptureSink()

@@ -333,6 +333,29 @@ class TestBenchHarness:
         assert flops == {"flops_per_image": 7.0}
         assert bench._parse_child_json("no json here\n{broken\n") is None
 
+    @pytest.mark.slow
+    def test_al_round_phase_smoke(self, monkeypatch):
+        """run_al_round_phase end to end at smoke scale: the phase that
+        carries BASELINE.md metric #1 must be known-working BEFORE its
+        one chance at a live-TPU capture.  (The imagenet variant differs
+        only in its dataset branch — JPEG tree + ImageFolderDataset —
+        which test_imagenet_pipeline covers; the full variant is
+        CPU-compile-bound, not CI material.)"""
+        monkeypatch.setenv("AL_BENCH_ROUND_SMOKE", "1")
+        bench = self._bench()
+        result = bench.run_al_round_phase("cifar", epochs=2)
+        assert result["phase"] == "al_round_cifar"
+        assert result["ips"] is None or result["ips"] > 0
+        for key in ("round_sec_warm", "round_sec_cold", "total_sec",
+                    "test_accuracy_rd1"):
+            assert result[key] is not None, key
+        rounds = result["phases_sec"]
+        for rd in ("round0", "round1"):
+            for name in ("query_time", "train_time", "test_time"):
+                assert rounds[rd][name] > 0, (rd, name)
+        # Warm round must not include round 0's XLA compiles.
+        assert result["round_sec_warm"] < result["round_sec_cold"]
+
     def test_kcenter_phase_tiny(self):
         bench = self._bench()
         result, picks = bench.run_kcenter_phase(8, dim=16, pool_n=128)
