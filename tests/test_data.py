@@ -192,6 +192,28 @@ class TestCifar10Fetch:
         # Idempotent: a second call must not re-download (dead URL).
         assert fetch_cifar10(dest, url="file:///nonexistent") == root
 
+    def test_facsimile_pixels_roundtrip(self, archive, tmp_path):
+        """The archive's plane-major [N, 3072] rows must decode back to
+        the exact HWC uint8 images that went in — a silent transpose in
+        either direction would feed permuted garbage to every
+        facsimile-backed run."""
+        from active_learning_tpu.data.cifar10 import (fetch_cifar10,
+                                                      load_cifar10_arrays)
+        from active_learning_tpu.data.synthetic import (_class_templates,
+                                                        _make_images)
+        path, md5 = archive
+        dest = str(tmp_path / "data")
+        fetch_cifar10(dest, url=f"file://{path}", expected_md5=md5)
+        (tr_im, tr_y), _ = load_cifar10_arrays(dest)
+        # Rebuild the generator chain write_cifar10_facsimile(seed=5)
+        # consumed: templates first, then batch 1 (250 rows at n_train=250
+        # -> per-file cap ceil(250/5)=50, so batch 1 holds rows 0..49).
+        rng = np.random.default_rng(5)
+        templates = _class_templates(10, 32, rng)
+        want_im, want_y = _make_images(50, templates, rng)
+        np.testing.assert_array_equal(tr_im[:50], want_im)
+        np.testing.assert_array_equal(tr_y[:50], want_y)
+
     def test_bad_md5_refuses_extraction(self, archive, tmp_path):
         from active_learning_tpu.data.cifar10 import fetch_cifar10
         path, _ = archive
