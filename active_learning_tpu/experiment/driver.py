@@ -65,14 +65,12 @@ def build_experiment(
         train_cfg = arg_pools_lib.get_train_config(
             cfg.arg_pool, cfg.dataset, pretrained_root=cfg.pretrained_root)
     if data is None:
-        imbalance_args = {
-            "imbalance_type": cfg.imbalance.imbalance_type,
-            "imbalance_factor": cfg.imbalance.imbalance_factor,
-            "imbalance_seed": cfg.imbalance.imbalance_seed,
-        }
+        # Pass the ImbalanceConfig itself: the dataset factories read it
+        # by attribute (a dict here crashed every config-driven
+        # imbalanced run with AttributeError).
         data = get_data(cfg.dataset, data_path=cfg.dataset_dir,
                         debug_mode=cfg.debug_mode,
-                        imbalance_args=imbalance_args,
+                        imbalance_args=cfg.imbalance,
                         download=cfg.download_data)
     train_set, test_set, al_set = data
     # Disk datasets with deterministic views get the experiment-lifetime

@@ -16,6 +16,13 @@ pixel content differs, and the output records which source was used.
     python scripts/cifar10_evidence.py [--model SSLResNet18] \
         [--rounds 5] [--budget 1000] [--epochs 8] [--out EVIDENCE_cifar10.json]
 
+``--imbalanced`` switches to the reference's imbalanced-CIFAR protocol
+(exp imbalance 0.1, class-weighted loss, reference gen_jobs.py:99-100):
+class-aware samplers (Balancing/BASE) vs random — the setting where
+strategy separation is expected even on template data, because the
+*pool composition* (not per-example noise) is what the strategies
+exploit.  ``--seeds N`` runs N independent replicas per strategy.
+
 The default model is SSLResNet18 when an accelerator backend is present,
 else a linear probe sized for the single-CPU sandbox (recorded in the
 output; pass --model to override).
@@ -86,11 +93,14 @@ def make_probe():
     return LinearProbe()
 
 
-def run_strategy(name: str, data, model_name: str, args, workdir: str
-                 ) -> dict:
+def run_strategy(name: str, data, model_name: str, args, workdir: str,
+                 run_seed: int = 0, imbalance=None) -> dict:
+    import dataclasses
+
     import jax
 
-    from active_learning_tpu.config import ExperimentConfig
+    from active_learning_tpu.config import (ExperimentConfig,
+                                            ImbalanceConfig)
     from active_learning_tpu.experiment.arg_pools import get_train_config
     from active_learning_tpu.experiment.driver import run_experiment
     from active_learning_tpu.utils.metrics import NullSink
@@ -106,15 +116,21 @@ def run_strategy(name: str, data, model_name: str, args, workdir: str
                 if k == "rd_test_accuracy":
                     self.curve[int(step)] = round(float(v), 4)
 
-    tmp = os.path.join(workdir, f"exp_{name}")
+    dataset = "imbalanced_cifar10" if imbalance else "cifar10"
+    tmp = os.path.join(workdir, f"exp_{name}_s{run_seed}")
     cfg = ExperimentConfig(
-        dataset="cifar10", dataset_dir=os.path.join(workdir, "data"),
+        dataset=dataset, dataset_dir=os.path.join(workdir, "data"),
         strategy=name, rounds=args.rounds, round_budget=args.budget,
         init_pool_size=args.budget, model=model_name, n_epoch=args.epochs,
-        early_stop_patience=0, exp_hash=f"evidence_{name}",
+        early_stop_patience=0, exp_hash=f"evidence_{name}_s{run_seed}",
+        run_seed=run_seed,
+        imbalance=imbalance or ImbalanceConfig(),
         log_dir=os.path.join(tmp, "logs"),
         ckpt_path=os.path.join(tmp, "ckpt"))
-    train_cfg = get_train_config("default", "cifar10")
+    # The registered default pool for the dataset: its imbalanced entry
+    # already carries the reference's class-weighted loss
+    # (strategy.py:444-457) — no local re-derivation.
+    train_cfg = get_train_config("default", dataset)
     model = None
     if model_name == "probe":
         # Calibrated for the pure-linear probe (matches the sklearn
@@ -122,8 +138,6 @@ def run_strategy(name: str, data, model_name: str, args, workdir: str
         # tuned with): gentler lr than the ResNet arg pool + weight
         # decay + cosine over exactly the run's epochs.  Pinned by
         # tests/test_cifar10_protocol.py.
-        import dataclasses
-
         from active_learning_tpu.config import (OptimizerConfig,
                                                 SchedulerConfig)
         train_cfg = dataclasses.replace(
@@ -136,7 +150,7 @@ def run_strategy(name: str, data, model_name: str, args, workdir: str
     t0 = time.perf_counter()
     run_experiment(cfg, sink=sink, data=data, train_cfg=train_cfg,
                    model=model)
-    return {"strategy": name, "model": model_name,
+    return {"strategy": name, "model": model_name, "run_seed": run_seed,
             "test_accuracy_by_round": sink.curve,
             "wall_sec": round(time.perf_counter() - t0, 1),
             "n_devices": len(jax.devices())}
@@ -149,10 +163,20 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=5)
     ap.add_argument("--budget", type=int, default=1000)
     ap.add_argument("--epochs", type=int, default=8)
-    ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "EVIDENCE_cifar10.json"))
+    ap.add_argument("--out", default=None)
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--imbalanced", action="store_true",
+                    help="the reference's imbalanced-CIFAR protocol "
+                         "(exp imbalance 0.1, class-weighted loss, "
+                         "class-aware samplers vs random) — the setting "
+                         "where strategy separation is expected")
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="independent run_seed replicas per strategy")
     args = ap.parse_args()
+    if args.out is None:
+        args.out = os.path.join(
+            REPO, "EVIDENCE_cifar10_imbalanced.json" if args.imbalanced
+            else "EVIDENCE_cifar10.json")
 
     import jax
 
@@ -164,27 +188,48 @@ def main() -> None:
     print(f"data source: {provenance['source']} ({platform}, "
           f"model {model_name})", flush=True)
 
+    from active_learning_tpu.config import ImbalanceConfig
     from active_learning_tpu.data import get_data
-    data = get_data("cifar10", data_path=os.path.join(workdir, "data"))
+
+    imbalance = None
+    if args.imbalanced:
+        # ONE protocol constant, shared by the data build and every
+        # run's recorded ExperimentConfig — a drift between the two
+        # would make resume metadata disagree with the loaded pool.
+        imbalance = ImbalanceConfig(imbalance_type="exp",
+                                    imbalance_factor=0.1, imbalance_seed=0)
+        data = get_data("imbalanced_cifar10",
+                        data_path=os.path.join(workdir, "data"),
+                        imbalance_args=imbalance)
+        strategies = ("BalancingSampler", "BASESampler", "RandomSampler")
+        protocol_ref = ("gen_jobs.py:99-100 imbalanced sweep (shortened); "
+                        "exp imbalance 0.1, class-weighted loss")
+    else:
+        data = get_data("cifar10", data_path=os.path.join(workdir, "data"))
+        strategies = ("MarginSampler", "RandomSampler")
+        protocol_ref = "gen_jobs.py:89-112 (shortened)"
 
     out = {
         "protocol": {"rounds": args.rounds, "round_budget": args.budget,
                      "init_pool_size": args.budget, "n_epoch": args.epochs,
-                     "reference": "gen_jobs.py:89-112 (shortened)"},
+                     "imbalanced": args.imbalanced, "seeds": args.seeds,
+                     "reference": protocol_ref},
         "data": provenance,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
         "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "runs": [],
     }
-    for strategy in ("MarginSampler", "RandomSampler"):
-        print(f"running {strategy} ...", flush=True)
-        out["runs"].append(run_strategy(strategy, data, model_name, args,
-                                        workdir))
-        with open(args.out, "w") as fh:
-            json.dump(out, fh, indent=1)
-    print(json.dumps({r["strategy"]: r["test_accuracy_by_round"]
-                      for r in out["runs"]}))
+    for seed in range(args.seeds):
+        for strategy in strategies:
+            print(f"running {strategy} (seed {seed}) ...", flush=True)
+            out["runs"].append(run_strategy(strategy, data, model_name,
+                                            args, workdir, run_seed=seed,
+                                            imbalance=imbalance))
+            with open(args.out, "w") as fh:
+                json.dump(out, fh, indent=1)
+    print(json.dumps({f"{r['strategy']}_s{r['run_seed']}":
+                      r["test_accuracy_by_round"] for r in out["runs"]}))
     print(f"evidence written to {args.out}")
 
 

@@ -46,6 +46,25 @@ def _run(cfg, tmp_path, name):
     return strategy, sink
 
 
+def test_config_driven_imbalanced_data_path(tmp_path):
+    """run_experiment with data=None must build the imbalanced dataset
+    from cfg.imbalance itself — the driver once downgraded the
+    ImbalanceConfig to a dict, crashing every config-driven imbalanced
+    run (the factories read it by attribute) while injected-data tests
+    passed."""
+    from active_learning_tpu.config import ImbalanceConfig
+
+    cfg = _cfg(tmp_path, "cfgimb", dataset="imbalanced_synthetic",
+               imbalance=ImbalanceConfig(imbalance_type="exp",
+                                         imbalance_factor=0.1,
+                                         imbalance_seed=3))
+    sink = JsonlSink(cfg.log_dir, experiment_key="cfgimb")
+    strategy = run_experiment(cfg, sink=sink,
+                              train_cfg=tiny_train_config(),
+                              model=TinyClassifier(num_classes=10))
+    assert strategy.pool.num_labeled == 16
+
+
 def _read_metrics(log_dir):
     events = []
     with open(os.path.join(log_dir, "metrics.jsonl")) as fh:
