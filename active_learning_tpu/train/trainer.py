@@ -400,17 +400,20 @@ class Trainer:
         # array that fits in HBM and no per-batch hook needs host batches,
         # upload it once and run each epoch as ONE jitted scan — identical
         # numerics (tests/test_trainer_parallel.py), zero per-batch
-        # dispatch.  Auto mode only engages once the labeled set is large
-        # enough for dispatch overhead to matter: the scan is a second
-        # sizeable XLA compile, a bad trade for a few-batch round.
+        # dispatch.  Auto mode: on accelerators ALWAYS (per-batch h2d +
+        # dispatch latency dominates small-round epochs, and the row/step
+        # bucketing means one compile serves consecutive AL rounds); on
+        # CPU only once the labeled set is large enough to amortize the
+        # scan's extra XLA compile.
         dr_possible = (batch_hook is None
                        and isinstance(getattr(train_set, "images", None),
                                       np.ndarray)
                        and train_set.images.nbytes <= 2 ** 31)
+        on_accel = self.mesh.devices.flat[0].platform != "cpu"
         use_dr = dr_possible and (
             self.cfg.device_resident is True
             or (self.cfg.device_resident is None
-                and len(labeled_idxs) >= 2048))
+                and (on_accel or len(labeled_idxs) >= 2048)))
         if use_dr:
             dr_images, dr_labels = self._device_resident_arrays(
                 train_set, labeled_idxs, bs)

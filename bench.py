@@ -123,7 +123,10 @@ PHASES = [
     # BASELINE.md metric #1: real end-to-end AL rounds through the
     # production driver.  iters is the per-round epoch count.
     ("al_round_cifar", 4, 128, 900),
-    ("al_round_imagenet", 2, 128, 900),
+    # Cold round-0 query alone decodes the full 50k JPEG tree (~420s
+    # measured through the tunnel), so the first attempt needs the
+    # largest window of any phase.
+    ("al_round_imagenet", 2, 128, 1800),
 ]
 # Stop launching fresh attempts past this wall-clock: the guaranteed JSON
 # line must land WELL inside the driver's outer timeout (round 3 died at
@@ -728,20 +731,34 @@ def _time_loop(step_once, sync, iters: int, warmup: int = 3) -> float:
 
 
 def _train_runner(trainer, batch, state, n_classes, view, seed: int):
-    """(step_once, sync, holder) driving one train step per call; the
-    holder chains state/key so the final loss fetch is data-dependent on
-    every step."""
+    """(step_once, sync, holder) driving one train step per call with ONE
+    dispatch per iteration: the PRNG split is folded into the same jitted
+    call as the step (an eager per-iteration split would add a second
+    dispatch, which on a tunneled remote backend costs a round-trip
+    comparable to the step itself — same discipline as _score_runner).
+    The holder chains state/key so the final loss fetch is data-dependent
+    on every step."""
+    import functools
+
     import jax
     import jax.numpy as jnp
 
-    h = {"state": state, "key": jax.random.PRNGKey(seed), "loss": None}
     cw = jnp.ones(n_classes, jnp.float32)
     lr = jnp.float32(0.1)
 
+    @functools.partial(jax.jit, static_argnames=("view",),
+                       donate_argnums=(0, 1))
+    def chained(state, key, batch, lr, cw, view):
+        key, sub = jax.random.split(key)
+        state, loss = trainer._train_step(state, batch, sub, lr, cw,
+                                          view=view)
+        return state, key, loss
+
+    h = {"state": state, "key": jax.random.PRNGKey(seed), "loss": None}
+
     def step_once():
-        h["key"], sub = jax.random.split(h["key"])
-        h["state"], h["loss"] = trainer._train_step(
-            h["state"], batch, sub, lr, cw, view=view)
+        h["state"], h["key"], h["loss"] = chained(
+            h["state"], h["key"], batch, lr, cw, view=view)
 
     return step_once, (lambda: float(h["loss"])), h
 
@@ -929,6 +946,15 @@ def _parse_child_json(stdout: str, required=("ips", "ips_per_chip")):
     return None
 
 
+def _halve_iters(iters: int) -> int:
+    """Retry iteration cut that can never INCREASE the work: the floor of
+    10 exists for timing stability of per-step phases (iters >= 20), but
+    the al_round phases count EPOCHS (2-4) — flooring those at 10 made a
+    timed-out attempt's retry strictly longer than the attempt that
+    already died (observed: al_round_imagenet 2 epochs -> retry at 10)."""
+    return max(10, iters // 2) if iters > 10 else max(1, iters // 2)
+
+
 def run_phase_with_retries(name: str, iters: int, per_chip: int,
                            timeout: float, deadline: float,
                            max_attempts: int = 2):
@@ -947,8 +973,14 @@ def run_phase_with_retries(name: str, iters: int, per_chip: int,
         remaining = deadline - time.monotonic()
         if remaining <= 30:
             return None, failure or "wall-clock budget exhausted"
+        # Reserve ~90s of budget past any single attempt: a hung child
+        # granted the full remainder would starve the cached-evidence
+        # fallback, MFU back-fill, and the final emit (phase timeouts can
+        # legitimately exceed the DEFAULT total budget — al_round_imagenet
+        # at 1800s is sized for AL_BENCH_BUDGET_S-raised runs, and under
+        # the default it degrades to whatever window this cap grants).
         attempt_timeout = min(timeout if attempt == 0 else timeout * 0.75,
-                              remaining)
+                              max(60.0, remaining - 90.0), remaining)
         cmd = [sys.executable, os.path.abspath(__file__), "--phase", name,
                "--iters", str(iters), "--per-chip-batch", str(per_chip)]
         env = None
@@ -986,7 +1018,7 @@ def run_phase_with_retries(name: str, iters: int, per_chip: int,
             log(f"[parent] {name}: {failure}")
             if "RESOURCE_EXHAUSTED" in partial:
                 per_chip = max(16, per_chip // 2)
-            iters = max(10, iters // 2)
+            iters = _halve_iters(iters)
             continue
         sys.stderr.write(proc.stderr[-4000:])
         if proc.returncode == 0:
@@ -1003,7 +1035,7 @@ def run_phase_with_retries(name: str, iters: int, per_chip: int,
         elif "UNAVAILABLE" in tail or "DEADLINE_EXCEEDED" in tail \
                 or "failed to initialize" in tail.lower():
             time.sleep(15)  # transient backend trouble; let it settle
-        iters = max(10, iters // 2)
+        iters = _halve_iters(iters)
     return None, failure
 
 
