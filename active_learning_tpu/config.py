@@ -133,8 +133,10 @@ class TrainConfig:
     # reference scores with its test-loader batch (100, e.g.
     # src/arg_pools/default.py loader_te_args), which on an 8-chip mesh is
     # ~12 rows per chip — far below MXU-efficient occupancy.  Auto keeps
-    # the reference batch on CPU (tests, parity) and raises it to at
-    # least 128 rows PER CHIP on accelerators.  Scores are per-example
+    # the reference batch on CPU (tests, parity) and raises it to a
+    # row-size-scaled floor PER CHIP on accelerators (512 for <=64px
+    # rows, 256 above, 128 when the row shape is unknown — v5e-measured,
+    # Trainer.eval_batch_size).  Scores are per-example
     # statistics under eval-mode BN, so the batch size changes throughput
     # only, never a score.
     score_batch_size: Optional[int] = None

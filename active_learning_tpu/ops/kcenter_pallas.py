@@ -18,10 +18,18 @@ expression); on a real MXU the tiled accumulation order differs from
 XLA's matvec, so float32 rounding can differ in the last ulp and an
 exact argmax tie could flip a pick.  bench.py's A/B therefore also
 reports whether the on-TPU pick sequences match
-(``pallas_picks_match``).  Wiring into kcenter_greedy stays opt-in
-(AL_TPU_KCENTER_PALLAS=1) until that A/B shows it faster on the target
-generation — see DESIGN.md §5 — and the caller falls back to the XLA
-scan if the compiled kernel fails at runtime (strategies/kcenter.py).
+(``pallas_picks_match``).
+
+**Hardware A/B verdict (v5e, 2026-07-31, BENCH r5): the XLA scan
+wins.** At N=50k, D=2048, budget=10k the kernel ran 552 picks/s vs the
+scan's 826 (0.67x) and ``pallas_picks_match=False`` — the rounding
+divergence above is real on hardware, not hypothetical.  XLA's fused
+matvec is already HBM-bound here, so the restructured layout buys no
+bandwidth and the kernel's per-pick launch overhead dominates.  The
+kernel therefore stays opt-in (AL_TPU_KCENTER_PALLAS=1), kept as the
+scaffold for a future multi-pick batched variant — see DESIGN.md §5 —
+and the caller falls back to the XLA scan if the compiled kernel fails
+at runtime (strategies/kcenter.py).
 """
 
 from __future__ import annotations

@@ -263,15 +263,17 @@ class Strategy:
 
     def _score_batch_size(self) -> int:
         """Global scoring batch: explicit config wins; auto keeps the
-        reference's test-loader batch on CPU and raises it to >=128 rows
-        per chip on accelerators (see TrainConfig.score_batch_size —
-        scoring is per-example under eval BN, so this is throughput-only)."""
+        reference's test-loader batch on CPU and raises it to a
+        row-size-scaled per-chip floor on accelerators (see
+        Trainer.eval_batch_size — scoring is per-example under eval BN,
+        so this is throughput-only)."""
         explicit = self.train_cfg.score_batch_size
         if explicit:
             return self.trainer.padded_batch_size(int(explicit))
         # Auto: ONE policy with evaluation (Trainer.eval_batch_size) —
         # the floor must never diverge between the two passes.
-        return self.trainer.padded_batch_size(self.trainer.eval_batch_size())
+        return self.trainer.padded_batch_size(
+            self.trainer.eval_batch_size(self.al_set))
 
     def _get_score_step(self, kind: str) -> Callable:
         if kind not in self._score_steps:

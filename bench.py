@@ -222,14 +222,20 @@ def _ensure_jpeg_tree(root: str, n_images: int, n_classes: int = 100
     return time.perf_counter() - t0
 
 
-def run_datapath_phase(n_images: int, per_chip: int) -> dict:
+def run_datapath_phase(n_images: int, per_chip: int):
     """End-to-end rehearsal of the ImageNet scoring data path: disk JPEGs
     -> native C++ batch decode/crop/resize -> threaded prefetch ->
     mesh-sharded ResNet-50 scoring via collect_pool (which also enforces
     score/index alignment over the whole pass).  Reports the end-to-end
     scoring rate, the decode-only rate, and the per-core decode rate —
     the number that says how many host cores a full-size run needs to
-    keep the mesh fed."""
+    keep the mesh fed.
+
+    GENERATOR: yields the result after each completed measurement (cold
+    scored pass, warm scored pass, warm gather decomposition) so a
+    timeout mid-phase loses only the unfinished measurement — the caller
+    prints each snapshot as its own JSON line and the parent keeps the
+    last parseable one."""
     import tempfile
 
     import numpy as np
@@ -302,7 +308,8 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
         result.update(ips=round(decode_ips, 1),
                       ips_per_chip=round(decode_ips / n_chips, 1),
                       decode_only=True)
-        return result
+        yield result
+        return
 
     # Full scoring pass over the whole tree, decode overlapped with device
     # compute exactly as a real acquisition round runs it — INCLUDING the
@@ -327,8 +334,8 @@ def run_datapath_phase(n_images: int, per_chip: int) -> dict:
     cached_set = maybe_wrap_decoded(dataset, cache_dir, 32 << 30)
     result["decoded_cache"] = cached_set is not dataset
     try:
-        return _datapath_model_passes(result, dataset, cached_set,
-                                      batch_size, threads, mesh)
+        yield from _datapath_model_passes(result, dataset, cached_set,
+                                          batch_size, threads, mesh)
     finally:
         # Pool-sized uint8 data must not squat in persistent ~/.cache
         # after the bench (and the next run's round 0 must start cold).
@@ -365,6 +372,7 @@ def _datapath_model_passes(result, dataset, cached_set, batch_size,
     ips = len(dataset) / score_sec
     result.update(ips=round(ips, 1), ips_per_chip=round(ips / n_chips, 1),
                   score_sec=round(score_sec, 1))
+    yield dict(result)  # cold pass is safe with the parent
     if cached_set is not dataset:
         # Steady state: rounds 1+ re-score the pool from the warm cache.
         t0 = time.perf_counter()
@@ -375,7 +383,20 @@ def _datapath_model_passes(result, dataset, cached_set, batch_size,
         assert len(out["margin"]) == len(dataset)
         result.update(ips_warm=round(len(dataset) / warm_sec, 1),
                       warm_score_sec=round(warm_sec, 1))
-    return result
+        yield dict(result)  # warm pass is safe with the parent
+        # Host-side-only warm rate (cache gather + batch assembly, no
+        # device work): decomposes ips_warm into host vs device+h2d the
+        # way decode_ips does for the cold pass — on a 1-core sandbox the
+        # warm pass is HOST-bound and this number says by how much.
+        t0 = time.perf_counter()
+        rows = 0
+        for start in range(0, len(dataset), batch_size):
+            rows += len(cached_set.gather(
+                all_idxs[start:start + batch_size]))
+        gather_sec = time.perf_counter() - t0
+        result.update(warm_gather_ips=round(rows / gather_sec, 1),
+                      warm_gather_sec=round(gather_sec, 1))
+        yield dict(result)
 
 
 def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
@@ -798,7 +819,7 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
     import jax.numpy as jnp
 
     if phase == "imagenet_datapath":
-        yield run_datapath_phase(iters * 1000, per_chip)
+        yield from run_datapath_phase(iters * 1000, per_chip)
         return
     if phase.startswith("al_round_"):
         yield run_al_round_phase(phase[len("al_round_"):], iters)

@@ -251,7 +251,8 @@ class TestScoreBatchSize:
         real_mesh = s.trainer.mesh
         s.trainer.mesh = FakeMesh()
         try:
-            floor = 128 * s.trainer.n_devices
+            # 32px synthetic pool -> the small-row 512/chip floor.
+            floor = 512 * s.trainer.n_devices
             assert s._score_batch_size() == \
                 s.trainer.padded_batch_size(floor)
         finally:

@@ -378,6 +378,16 @@ def test_eval_batch_floor_cpu_keeps_reference_batch():
     real = trainer.mesh
     trainer.mesh = FakeMesh()
     try:
+        # Unknown row shape: conservative 128/chip floor.
         assert trainer.eval_batch_size() == 128 * trainer.n_devices
+
+        class Small:  # 32px rows: 512/chip (v5e probe: +47% over 256)
+            image_shape = (32, 32, 3)
+
+        class Large:  # ImageNet-res rows: 256/chip (+11% over 128)
+            image_shape = (224, 224, 3)
+
+        assert trainer.eval_batch_size(Small()) == 512 * trainer.n_devices
+        assert trainer.eval_batch_size(Large()) == 256 * trainer.n_devices
     finally:
         trainer.mesh = real
