@@ -61,6 +61,11 @@ class CachedEvalRows:
         self.view = dataset.view
         self.targets = dataset.targets
         self.num_classes = dataset.num_classes
+        # Proxied so Trainer.eval_batch_size sees the row size through the
+        # wrapper — the scoring and validation passes share one batch-floor
+        # policy, and a wrapper hiding image_shape would silently drop the
+        # eval pass to the conservative unknown-shape floor.
+        self.image_shape = dataset.image_shape
         self._rows: Dict[int, np.ndarray] = {}
         self._bytes = 0
         self._max_bytes = int(max_bytes)

@@ -78,7 +78,11 @@ def measure(batch_per_chip: int, iters: int) -> dict:
                            jnp.asarray(host["image"][:8]), train=False)
     variables = mesh_lib.replicate(variables, mesh)
     params, batch_stats = variables["params"], variables["batch_stats"]
-    tx = optax.sgd(1.0, momentum=0.9)
+    # Same convention as the production optimizer (train/optim.py): the
+    # transform returns RAW momentum-traced grads and the step applies
+    # ``-lr`` itself — optax.sgd would already negate, and a second
+    # negation below would ascend the loss.
+    tx = optax.trace(decay=0.9)
     opt_state = mesh_lib.replicate(tx.init(params), mesh)
     cw = jnp.ones(1000, jnp.float32)
 
