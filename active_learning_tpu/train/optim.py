@@ -56,8 +56,23 @@ def _step_lr(cfg: SchedulerConfig, base_lr: float) -> Callable[[int], float]:
 
 
 def _cosine_lr(cfg: SchedulerConfig, base_lr: float) -> Callable[[int], float]:
+    warm = max(0, cfg.warmup_epochs)
+    if warm >= cfg.t_max:
+        # A ramp as long as the whole schedule never reaches peak lr and
+        # leaves no cosine phase — a silent degenerate schedule; callers
+        # must clamp (e.g. min(3, epochs // 2)).
+        raise ValueError(
+            f"warmup_epochs ({warm}) must be < t_max ({cfg.t_max})")
+
     def lr_at(epoch0: int) -> float:
-        return base_lr * (1 + math.cos(math.pi * epoch0 / cfg.t_max)) / 2
+        if epoch0 < warm:
+            # Linear ramp; epoch 0 starts at base_lr/warm, not 0 — an
+            # all-zero first epoch would waste a whole epoch of a short
+            # AL round.
+            return base_lr * (epoch0 + 1) / warm
+        span = max(1, cfg.t_max - warm)
+        return base_lr * (1 + math.cos(math.pi * (epoch0 - warm) / span)) / 2
+
     return lr_at
 
 

@@ -391,3 +391,29 @@ def test_eval_batch_floor_cpu_keeps_reference_batch():
         assert trainer.eval_batch_size(Large()) == 256 * trainer.n_devices
     finally:
         trainer.mesh = real
+
+
+def test_cosine_warmup_schedule():
+    """warmup_epochs=0 is exactly torch CosineAnnealingLR; warmup>0 ramps
+    linearly (never starting at 0) then runs the cosine over the
+    remaining epochs — the re-init-every-round cold-start fix
+    (SchedulerConfig.warmup_epochs)."""
+    import math
+
+    from active_learning_tpu.config import SchedulerConfig
+    from active_learning_tpu.train.optim import make_lr_schedule
+
+    plain = make_lr_schedule(SchedulerConfig(name="cosine", t_max=10), 0.1)
+    for e in range(10):
+        expected = 0.1 * (1 + math.cos(math.pi * e / 10)) / 2
+        assert abs(plain(e) - expected) < 1e-12
+
+    warm = make_lr_schedule(
+        SchedulerConfig(name="cosine", t_max=10, warmup_epochs=3), 0.1)
+    assert abs(warm(0) - 0.1 / 3) < 1e-12
+    assert abs(warm(1) - 0.2 / 3) < 1e-12
+    assert abs(warm(2) - 0.1) < 1e-12
+    # Cosine span starts after the ramp and ends where t_max says.
+    assert abs(warm(3) - 0.1) < 1e-12
+    assert warm(9) < warm(3)
+    assert abs(warm(9) - 0.1 * (1 + math.cos(math.pi * 6 / 7)) / 2) < 1e-12
