@@ -20,16 +20,18 @@ exact argmax tie could flip a pick.  bench.py's A/B therefore also
 reports whether the on-TPU pick sequences match
 (``pallas_picks_match``).
 
-**Hardware A/B verdict (v5e, 2026-07-31, BENCH r5): the XLA scan
-wins.** At N=50k, D=2048, budget=10k the kernel ran 552 picks/s vs the
-scan's 826 (0.67x) and ``pallas_picks_match=False`` — the rounding
-divergence above is real on hardware, not hypothetical.  XLA's fused
-matvec is already HBM-bound here, so the restructured layout buys no
-bandwidth and the kernel's per-pick launch overhead dominates.  The
-kernel therefore stays opt-in (AL_TPU_KCENTER_PALLAS=1), kept as the
-scaffold for a future multi-pick batched variant — see DESIGN.md §5 —
-and the caller falls back to the XLA scan if the compiled kernel fails
-at runtime (strategies/kcenter.py).
+**Hardware A/B verdict (v5e, 2026-07-31, BENCH r5, two runs): keep the
+XLA scan.** At N=50k, D=2048, budget=10k the kernel measured 552
+picks/s vs the scan's 826 (0.67x) in one backend window and 874 vs 789
+(1.11x) in another — parity within tunnel noise, nowhere near a win
+worth a numerics change — and ``pallas_picks_match=False`` in BOTH
+runs: the accumulation-order rounding divergence above is real on
+hardware, not hypothetical.  XLA's fused matvec is already HBM-bound
+here, so the restructured layout buys no bandwidth it doesn't already
+have.  The kernel therefore stays opt-in (AL_TPU_KCENTER_PALLAS=1),
+kept as the scaffold for a future multi-pick batched variant — see
+DESIGN.md §5 — and the caller falls back to the XLA scan if the
+compiled kernel fails at runtime (strategies/kcenter.py).
 """
 
 from __future__ import annotations
