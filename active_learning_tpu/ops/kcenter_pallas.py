@@ -20,13 +20,13 @@ exact argmax tie could flip a pick.  bench.py's A/B therefore also
 reports whether the on-TPU pick sequences match
 (``pallas_picks_match``).
 
-**Hardware A/B verdict (v5e, 2026-07-31, BENCH r5, two runs): keep the
-XLA scan.** At N=50k, D=2048, budget=10k the kernel measured 552
-picks/s vs the scan's 826 (0.67x) in one backend window and 874 vs 789
-(1.11x) in another — parity within tunnel noise, nowhere near a win
-worth a numerics change — and ``pallas_picks_match=False`` in BOTH
-runs: the accumulation-order rounding divergence above is real on
-hardware, not hypothetical.  XLA's fused matvec is already HBM-bound
+**Hardware A/B verdict (v5e, 2026-07-31, BENCH r5, three runs): keep
+the XLA scan.** At N=50k, D=2048, budget=10k the kernel measured 0.67x
+the scan (552 vs 826 picks/s), 1.11x (874 vs 789), and 0.93x (485 vs
+519) across three backend windows — parity within tunnel noise,
+nowhere near a win worth a numerics change — and
+``pallas_picks_match=False`` in ALL THREE runs: the accumulation-order
+rounding divergence above is real on hardware, not hypothetical.  XLA's fused matvec is already HBM-bound
 here, so the restructured layout buys no bandwidth it doesn't already
 have.  The kernel therefore stays opt-in (AL_TPU_KCENTER_PALLAS=1),
 kept as the scaffold for a future multi-pick batched variant — see

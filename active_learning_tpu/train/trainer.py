@@ -515,6 +515,14 @@ class Trainer:
                 # replay the same augmentation sequence.
                 train_set.set_epoch(round_idx * (n_epoch + 1) + epoch)
             lr = jnp.float32(self.lr_at(epoch - 1))
+            # train_loss stays a DEVICE scalar until the end of the fit:
+            # fetching it here would block the host on the epoch's compute
+            # before validation could even be dispatched — one avoidable
+            # host round-trip per epoch, which on a remote-tunneled
+            # backend is a measurable slice of a small-round epoch.  The
+            # history is materialized to floats right before returning;
+            # mid-fit history entries hold live device arrays, so history
+            # must never be added to the fit-state payload as-is.
             if use_dr:
                 idx_mat, mask_mat, valid, steps_real = \
                     self._epoch_index_matrix(len(labeled_idxs), bs, rng)
@@ -522,7 +530,7 @@ class Trainer:
                     state, dr_images, dr_labels, jnp.asarray(idx_mat),
                     jnp.asarray(mask_mat), jnp.asarray(valid), key, lr,
                     class_weights, view=train_set.view)
-                epoch_loss = float(jnp.sum(losses)) / steps_real
+                epoch_loss = jnp.sum(losses) / steps_real
             else:
                 losses = []
                 for batch in iterate_batches(
@@ -540,7 +548,7 @@ class Trainer:
                         # Receives the already-sharded device batch — no
                         # second host->device transfer on the hot path.
                         batch_hook(epoch, sharded)
-                epoch_loss = (float(jnp.mean(jnp.stack(losses)))
+                epoch_loss = (jnp.mean(jnp.stack(losses))
                               if losses else 0.0)
             record = {"epoch": epoch, "lr": float(lr),
                       "train_loss": epoch_loss}
@@ -637,6 +645,10 @@ class Trainer:
             multihost_utils.sync_global_devices("fit_ckpts_written")
         self.logger.info(
             f"Sanity Check: Best ckpt occurs on epoch {best_epoch}")
+        for rec in history:
+            # Deferred train-loss fetch (see the epoch loop): one bulk
+            # materialization here instead of one host sync per epoch.
+            rec["train_loss"] = float(rec["train_loss"])
         return FitResult(state=state, best_epoch=best_epoch,
                          best_perf=best_perf, epochs_run=epochs_run,
                          history=history)
