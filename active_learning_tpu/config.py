@@ -242,6 +242,15 @@ class ExperimentConfig:
     # TrainConfig.dtype ("auto" = bf16 on TPU / f32 elsewhere).
     dtype: Optional[str] = None
 
+    # Device-resident pool budget override (bytes): None defers to the
+    # arg pool's TrainConfig.resident_scoring_bytes (conservative 2 GB).
+    # On 16 GB-HBM chips, sizing this over the decoded al-pool (e.g.
+    # 10000000000 = 10 GB for a 50k ImageNet-shape pool at 7.5 GB,
+    # --resident_scoring_bytes takes a plain integer) pins the pool in HBM
+    # after round 0's decode and turns every later query/eval pass into
+    # on-device gathers — no per-batch host->device image traffic.
+    resident_scoring_bytes: Optional[int] = None
+
     # Coreset / BADGE partitioning (parser.py:74-79)
     subset_labeled: Optional[int] = None
     subset_unlabeled: Optional[int] = None

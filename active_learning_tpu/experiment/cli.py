@@ -74,6 +74,13 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", type=str, default=None,
                    choices=["auto", "bfloat16", "float32"],
                    help="model compute precision (params/BN stay float32)")
+    p.add_argument("--resident_scoring_bytes", type=int, default=None,
+                   help="device-resident pool budget in bytes (default: "
+                        "the arg pool's conservative 2 GB).  On 16 GB "
+                        "chips, size this over the decoded al pool to pin "
+                        "it in HBM after round 0 — later query/eval "
+                        "passes become on-device gathers.  0 disables "
+                        "residency.")
     # Coreset / BADGE scale controls (parser.py:74-79)
     p.add_argument("--subset_labeled", type=int, default=None)
     p.add_argument("--subset_unlabeled", type=int, default=None)
@@ -130,6 +137,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         debug_mode=args.debug_mode,
         profile_dir=args.profile_dir,
         dtype=args.dtype,
+        resident_scoring_bytes=args.resident_scoring_bytes,
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,
         partitions=args.partitions,

@@ -20,6 +20,7 @@ metric names (main_al.py:24-40).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import uuid
 from datetime import date
@@ -103,6 +104,11 @@ def build_experiment(
                             freeze_feature=cfg.freeze_feature,
                             num_classes=num_classes,
                             dtype=cfg.dtype or train_cfg.dtype)
+    if cfg.resident_scoring_bytes is not None:
+        # --resident_scoring_bytes beats the arg pool: HBM sizing is a
+        # per-chip deployment choice, not a dataset hyperparameter.
+        train_cfg = dataclasses.replace(
+            train_cfg, resident_scoring_bytes=cfg.resident_scoring_bytes)
     if mesh is None:
         mesh = mesh_lib.make_mesh(cfg.num_devices)
     trainer = Trainer(model, train_cfg, mesh, num_classes)

@@ -311,6 +311,48 @@ class TestGenJobs:
         ns = parser.parse_args(["--dataset", "cifar10"])
         assert cli.args_to_config(ns).download_data is False
 
+    def test_resident_scoring_bytes_flag_reaches_trainer(self, tmp_path):
+        """--resident_scoring_bytes is a per-chip HBM sizing override: it
+        must land on the TrainConfig the trainer and scoring share (the
+        default None defers to the arg pool's conservative budget, 0
+        disables residency) — asserted on the BUILT experiment, not just
+        the parsed config, so dropping the driver's override would fail
+        here."""
+        from active_learning_tpu.experiment import cli
+        from active_learning_tpu.experiment.driver import build_experiment
+
+        parser = cli.get_parser()
+        ns = parser.parse_args(["--dataset", "cifar10",
+                                "--resident_scoring_bytes", "10000000000"])
+        assert cli.args_to_config(ns).resident_scoring_bytes == 10 ** 10
+        ns = parser.parse_args(["--dataset", "cifar10"])
+        assert cli.args_to_config(ns).resident_scoring_bytes is None
+        ns = parser.parse_args(["--dataset", "cifar10",
+                                "--resident_scoring_bytes", "0"])
+        assert cli.args_to_config(ns).resident_scoring_bytes == 0
+
+        import dataclasses as dc
+
+        from active_learning_tpu.config import ExperimentConfig
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from helpers import tiny_train_config
+
+        for override, want in ((10 ** 10, 10 ** 10), (None, None)):
+            cfg = ExperimentConfig(
+                dataset="synthetic", strategy="MarginSampler", rounds=1,
+                round_budget=4, init_pool_size=4, n_epoch=1,
+                exp_hash=f"rsb{override}", enable_metrics=False,
+                resident_scoring_bytes=override,
+                log_dir=str(tmp_path / "logs"),
+                ckpt_path=str(tmp_path / "ck"))
+            base = tiny_train_config()
+            strategy = build_experiment(
+                cfg, data=get_data_synthetic(n_train=16, n_test=8),
+                train_cfg=base)
+            expect = base.resident_scoring_bytes if want is None else want
+            assert strategy.train_cfg.resident_scoring_bytes == expect
+            assert (strategy.trainer.cfg.resident_scoring_bytes == expect)
+
     def test_vaal_adversary_flag_uses_reference_spelling(self):
         """Published VAAL commands use --vaal_adversary_param
         (reference parser.py:84); both that and the short alias must

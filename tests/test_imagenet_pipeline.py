@@ -318,6 +318,59 @@ class TestDecodedPoolCache:
                                       want)
         assert calls == []
 
+    def test_full_cache_promotes_to_device_residency(self, jpeg_tree,
+                                                     tmp_path):
+        """A fully-populated cache exposes the memmap as ``.images`` and
+        thereby qualifies for the device-resident scoring path
+        (parallel/resident.py:eligible) — rounds 1+ of a disk-pool
+        experiment score via on-device gathers when the HBM budget
+        covers the pool.  While partial it must NOT qualify: a
+        half-empty memmap uploaded as real data would score zeros."""
+        import jax
+
+        from active_learning_tpu.data.cache import DecodedPoolCache
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.parallel import resident as resident_lib
+        from active_learning_tpu.strategies import scoring as scoring_lib
+
+        ds = make_ds(jpeg_tree, train=False)
+        cached = DecodedPoolCache(ds, str(tmp_path))
+        budget = 1 << 30
+
+        # Partial: one row decoded — no .images, not eligible.
+        cached.gather(np.asarray([0]))
+        assert getattr(cached, "images", None) is None
+        assert not resident_lib.eligible(cached, budget)
+
+        # Fully populated: promoted, and the resident scoring pass over
+        # the cache matches the host-batched pass bit for bit.
+        cached.gather(np.arange(len(cached)))
+        assert isinstance(cached.images, np.ndarray)
+        assert resident_lib.eligible(cached, budget)
+        assert not resident_lib.eligible(cached, cached.images.nbytes - 1)
+
+        from flax import linen as nn
+
+        class Probe(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return nn.Dense(4)(x.reshape(x.shape[0], -1)
+                                   .astype(np.float32))
+
+        mesh = mesh_lib.make_mesh(1)
+        model = Probe()
+        variables = model.init(jax.random.PRNGKey(0),
+                               cached.gather(np.arange(2)))
+        step = scoring_lib.make_prob_stats_step(model, cached.view)
+        idxs = np.arange(len(cached), dtype=np.int64)
+        host = scoring_lib.collect_pool(cached, idxs, 8, step, variables,
+                                        mesh)
+        res = scoring_lib.collect_pool(cached, idxs, 8, step, variables,
+                                       mesh, resident_cache={})
+        for k in host:
+            np.testing.assert_allclose(res[k], host[k], rtol=1e-6,
+                                       atol=1e-6, err_msg=k)
+
     def test_torn_write_not_served(self, jpeg_tree, tmp_path):
         """A row whose bytes landed but whose valid flag did not (crash
         between the two) must be re-decoded, and vice versa a zeroed row

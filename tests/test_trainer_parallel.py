@@ -159,6 +159,11 @@ class TestFitAndEval:
         losses = [h["train_loss"] for h in result.history]
         assert losses[-1] < losses[0]
         assert result.epochs_run == 5
+        # The returned history is plain floats: the per-epoch loss fetch
+        # is deferred to the end of the fit, and a device array leaking
+        # out here would mean a consumer can accidentally sync or
+        # serialize live buffers.
+        assert all(isinstance(v, float) for v in losses)
 
     def test_eval_matches_numpy_oracle(self):
         train_set, test_set, al_set = get_data_synthetic(
