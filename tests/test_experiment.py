@@ -394,6 +394,45 @@ class TestBenchHarness:
         assert flops == {"flops_per_image": 7.0}
         assert bench._parse_child_json("no json here\n{broken\n") is None
 
+    def test_crashed_child_keeps_completed_measurement(self, monkeypatch):
+        """A child that printed a complete measurement and then died in a
+        later optional pass produced real evidence: the parent must keep
+        it (same discipline as the timeout path) instead of burning a
+        retry and reporting failure."""
+        import types
+
+        bench = self._bench()
+        good = ('{"phase": "p", "ips": 5.0, "ips_per_chip": 5.0}\n')
+
+        calls = []
+
+        def fake_run(cmd, **kwargs):
+            calls.append(cmd)
+            return types.SimpleNamespace(returncode=1, stdout=good,
+                                         stderr="boom in optional pass")
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        result, failure = bench.run_phase_with_retries(
+            "p", iters=3, per_chip=8, timeout=30,
+            deadline=bench.time.monotonic() + 300)
+        assert failure is None
+        assert result == {"phase": "p", "ips": 5.0, "ips_per_chip": 5.0}
+        assert len(calls) == 1  # no retry burned
+
+        # Without any parseable stdout the crash is a real failure and
+        # the retry ladder proceeds.
+        def fake_run_bad(cmd, **kwargs):
+            calls.append(cmd)
+            return types.SimpleNamespace(returncode=1, stdout="",
+                                         stderr="hard crash")
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run_bad)
+        result, failure = bench.run_phase_with_retries(
+            "p", iters=3, per_chip=8, timeout=30,
+            deadline=bench.time.monotonic() + 300, max_attempts=2)
+        assert result is None and failure.startswith("exit 1")
+        assert len(calls) == 3  # both attempts of the ladder actually ran
+
     @pytest.mark.slow
     def test_al_round_phase_smoke(self, monkeypatch):
         """run_al_round_phase end to end at smoke scale: the phase that
