@@ -100,6 +100,7 @@ class Trainer:
         self.train_bn = train_bn
         self.n_devices = mesh.devices.size
         self._train_step = self._build_train_step()
+        self._chained_train_step = self._build_chained_train_step()
         self._epoch_scan: Optional[Callable] = None  # built on first use
         self._eval_steps: Dict[Any, Callable] = {}
         # ONE device-resident pool cache for the whole experiment, shared
@@ -196,6 +197,26 @@ class Trainer:
                                  step=state.step + 1), loss
 
         return train_step
+
+    def _build_chained_train_step(self):
+        """The host-batched fit path's step with the per-batch PRNG split
+        folded into the same jitted call — ONE dispatch per batch instead
+        of two (an eager ``jax.random.split`` is its own device dispatch,
+        a measurable round-trip per step on remote backends).  Key
+        consumption is identical to ``split`` + ``_train_step``, i.e. the
+        exact chain the device-resident epoch scan replicates, so all
+        three paths stay bit-identical (tests/test_trainer_parallel.py)."""
+        train_step = self._train_step
+
+        @functools.partial(jax.jit, static_argnames=("view",),
+                           donate_argnums=(0, 2))
+        def chained(state, batch, key, lr, class_weights, view):
+            new_key, sub = jax.random.split(key)
+            new_state, loss = train_step(state, batch, sub, lr,
+                                         class_weights, view=view)
+            return new_state, new_key, loss
+
+        return chained
 
     def _get_eval_step(self, view):
         if view not in self._eval_steps:
@@ -538,10 +559,9 @@ class Trainer:
                         num_threads=self.cfg.loader_tr.num_workers,
                         prefetch=self.cfg.loader_tr.prefetch,
                         local=mesh_lib.process_local_rows(self.mesh, bs)):
-                    key, sub = jax.random.split(key)
                     sharded = mesh_lib.shard_batch(batch, self.mesh)
-                    state, loss = self._train_step(
-                        state, sharded, sub, lr, class_weights,
+                    state, key, loss = self._chained_train_step(
+                        state, sharded, key, lr, class_weights,
                         view=train_set.view)
                     losses.append(loss)
                     if batch_hook is not None:

@@ -808,33 +808,21 @@ def _time_loop(step_once, sync, iters: int, warmup: int = 3) -> float:
 
 def _train_runner(trainer, batch, state, n_classes, view, seed: int):
     """(step_once, sync, holder) driving one train step per call with ONE
-    dispatch per iteration: the PRNG split is folded into the same jitted
-    call as the step (an eager per-iteration split would add a second
-    dispatch, which on a tunneled remote backend costs a round-trip
-    comparable to the step itself — same discipline as _score_runner).
-    The holder chains state/key so the final loss fetch is data-dependent
-    on every step."""
-    import functools
-
+    dispatch per iteration — the PRODUCTION chained step (PRNG split
+    folded into the jitted call, trainer._chained_train_step), so the
+    bench measures exactly the dispatch pattern the host-batched fit
+    loop runs.  The holder chains state/key so the final loss fetch is
+    data-dependent on every step."""
     import jax
     import jax.numpy as jnp
 
     cw = jnp.ones(n_classes, jnp.float32)
     lr = jnp.float32(0.1)
-
-    @functools.partial(jax.jit, static_argnames=("view",),
-                       donate_argnums=(0, 1))
-    def chained(state, key, batch, lr, cw, view):
-        key, sub = jax.random.split(key)
-        state, loss = trainer._train_step(state, batch, sub, lr, cw,
-                                          view=view)
-        return state, key, loss
-
     h = {"state": state, "key": jax.random.PRNGKey(seed), "loss": None}
 
     def step_once():
-        h["state"], h["key"], h["loss"] = chained(
-            h["state"], h["key"], batch, lr, cw, view=view)
+        h["state"], h["key"], h["loss"] = trainer._chained_train_step(
+            h["state"], batch, h["key"], lr, cw, view=view)
 
     return step_once, (lambda: float(h["loss"])), h
 
