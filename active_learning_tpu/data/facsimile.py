@@ -43,9 +43,26 @@ def write_cifar10_facsimile(path: str, n_train: int = 50000,
     ``noise_sigma``/``contrast`` set task difficulty (contrast scales the
     class templates toward mid-grey).  At the synthetic defaults a linear
     model saturates from the first batch of labels; evidence runs use
-    contrast ~0.06 with sigma ~60, calibrated (sklearn logistic
-    regression) to ~40% test accuracy at 1k labels rising to ~65% at 6k —
-    a curve that can actually show learning and strategy differences."""
+    MODEL-CALIBRATED settings, because the informative band depends
+    sharply on the learner (live-v5e map, 2026-07-31, shortened protocol
+    with cosine+warmup at lr 0.04-0.05):
+
+      * linear probe: 0.06/σ60 → ~40% at 1k labels rising to ~65% at 6k
+        (matches the sklearn logistic-regression ceiling).
+      * from-scratch ResNet-18: 0.06/σ60 → pinned at CHANCE (the CNN
+        fits noise before finding the template subspace a linear model
+        reads off directly); 0.08/σ65 → bistable (some rounds 52%, some
+        chance — and Margin's preference for the noisiest examples makes
+        ITS rounds likelier to collapse); **0.10/σ60 → the informative
+        band** (67% at 1k labels rising to ~90% at 5k, stable across
+        seeds WITH 3 warmup epochs — without warmup even 0.12/σ55
+        collapses on re-init); 0.12/σ55 → 85-94%; ≥0.25/σ50 → ~100% by
+        round 0 (Bayes-trivial).
+
+    The Bayes classifier for template+iid-Gaussian is linear, so the
+    probe tracks the Bayes ceiling while a CNN transitions sharply from
+    noise-fitting to near-Bayes — calibrate per model, not per dataset
+    (scripts/cifar10_evidence.py applies these defaults)."""
     rng = np.random.default_rng(seed)
     templates = _class_templates(10, 32, rng)
     templates = 127.5 + contrast * (templates - 127.5)
