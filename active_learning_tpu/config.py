@@ -255,6 +255,20 @@ class ExperimentConfig:
     subset_labeled: Optional[int] = None
     subset_unlabeled: Optional[int] = None
     partitions: int = 1
+    # Batched greedy k-center: provisionally-farthest picks folded into
+    # the min-distance vector per pool pass, with an exact in-batch
+    # re-check so the selection is pick-for-pick identical to q=1
+    # (strategies/kcenter.py).  8 = one center tile of the fused Pallas
+    # kernel; 1 restores the sequential scan.  Randomized (BADGE D^2)
+    # selection always draws one pick at a time regardless.
+    kcenter_batch: int = 8
+
+    # Persistent XLA compilation-cache directory: round N+1 and run M+1
+    # reuse round N's compiled executables from disk instead of paying
+    # the cold-compile tax again (experiment/driver.py applies it
+    # process-wide at run start).  None = ~/.cache/al_tpu_xla_cache
+    # (or $JAX_COMPILATION_CACHE_DIR); "" disables.
+    compilation_cache_dir: Optional[str] = None
 
     # VAAL
     vaal: VAALConfig = dataclasses.field(default_factory=VAALConfig)

@@ -85,6 +85,13 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--subset_labeled", type=int, default=None)
     p.add_argument("--subset_unlabeled", type=int, default=None)
     p.add_argument("--partitions", type=int, default=1)
+    p.add_argument("--kcenter_batch", type=int, default=8,
+                   help="batched greedy k-center: picks folded per pool "
+                        "pass (exact re-check keeps selection identical "
+                        "to 1); 1 = sequential scan")
+    p.add_argument("--compilation_cache_dir", type=str, default=None,
+                   help="persistent XLA compilation cache (default "
+                        "~/.cache/al_tpu_xla_cache; '' disables)")
     # VAAL (parser.py:81-92)
     p.add_argument("--vae_latent_dim", type=int, default=64)
     # Reference spelling (parser.py:84); --adversary_param kept as an alias
@@ -141,6 +148,8 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,
         partitions=args.partitions,
+        kcenter_batch=args.kcenter_batch,
+        compilation_cache_dir=args.compilation_cache_dir,
         vaal=VAALConfig(
             vae_latent_dim=args.vae_latent_dim,
             adversary_param=args.vaal_adversary_param,

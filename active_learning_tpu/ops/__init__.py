@@ -3,6 +3,9 @@
 Only ops where measured XLA performance leaves headroom get a kernel —
 see DESIGN.md §5 for the decision record.  Current contents:
 
-  * kcenter_pallas — the k-center scan's per-pick fused distance-update
-    (matvec + d_new + running-min in one pass over the factor matrix).
+  * kcenter_pallas — the k-center selection's fused batched
+    distance-update + block-local argmax (Q-center MXU matmul, min over
+    centers, running-min update and masked argmax in one VMEM-resident
+    pass over the transposed factor tiles); routed by the measured
+    dispatcher in strategies/kcenter.py.
 """

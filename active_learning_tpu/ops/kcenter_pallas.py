@@ -1,42 +1,46 @@
-"""Pallas TPU kernel for the k-center scan's distance update.
+"""Fused Pallas TPU kernel for the k-center selection hot path.
 
-The greedy selection loop (strategies/kcenter.py) spends its time in one
-operation per pick: ``min_dist <- min(min_dist, sqn + sqn[idx] - 2 X@X[idx])``
-— a skinny matvec over the whole [N, D] factor matrix plus two [N]
-elementwise passes.  XLA runs this at well under HBM bandwidth on TPU (the
-matvec's output lane width is 1), so this kernel restructures the layout:
+The greedy loop (strategies/kcenter.py) spends its time in one operation
+per scan step: fold a set of freshly-picked centers into the running
+min-distance vector, then find the farthest remaining point.  Expressed
+in XLA that is (a) a skinny matmul over the whole [N, D] factor matrix,
+(b) an elementwise min pass over min_dist, and (c) a masked argmax pass
+over min_dist — the pool-sized operands stream from HBM more than once
+per pick.  This kernel restructures the layout and fuses all three:
 
   * the factor matrix is stored TRANSPOSED, XT [D, N], so pool rows lie
-    along the lane dimension and the matvec becomes [1, TILE_D] @
-    [TILE_D, TILE_N] MXU tiles accumulating a [1, TILE_N] strip;
-  * d_new and the running min fuse into the same pass — one read of XT,
-    one read-modify of min_dist, nothing else touches HBM.
+    along the lane dimension and the center matmul becomes
+    [Q, TILE_D] @ [TILE_D, TILE_N] MXU tiles accumulating a [Q, TILE_N]
+    strip — Q centers amortize ONE read of the pool tiles (the batched
+    greedy's q picks per step map straight onto Q);
+  * the [Q, TILE_N] distance strip, the min over centers, the running
+    min_dist update, and the BLOCK-LOCAL masked argmax all happen while
+    the tile is resident in VMEM: per pick-batch the pool is read once,
+    and only per-block (max, argmax) pairs plus the updated min row go
+    back to HBM.  The host-side scan finishes the argmax with a trivial
+    [N / TILE_N] reduction.
 
 Equivalence to the XLA path is proven in INTERPRET mode
-(tests/test_kcenter_pallas.py pins the kernel against the plain jnp
-expression); on a real MXU the tiled accumulation order differs from
-XLA's matvec, so float32 rounding can differ in the last ulp and an
-exact argmax tie could flip a pick.  bench.py's A/B therefore also
-reports whether the on-TPU pick sequences match
+(tests/test_kcenter_pallas.py pins the fused output and the argmax
+against the plain jnp expressions); on a real MXU the tiled accumulation
+order differs from XLA's matmul, so float32 rounding can differ in the
+last ulp and an exact argmax tie could flip a pick.  bench.py's A/B
+therefore also reports whether the on-TPU pick sequences match
 (``pallas_picks_match``).
 
-**Hardware A/B verdict (v5e, 2026-07-31, BENCH r5, three runs): keep
-the XLA scan.** At N=50k, D=2048, budget=10k the kernel measured 0.67x
-the scan (552 vs 826 picks/s), 1.11x (874 vs 789), and 0.93x (485 vs
-519) across three backend windows — parity within tunnel noise,
-nowhere near a win worth a numerics change — and
-``pallas_picks_match=False`` in ALL THREE runs: the accumulation-order
-rounding divergence above is real on hardware, not hypothetical.  XLA's fused matvec is already HBM-bound
-here, so the restructured layout buys no bandwidth it doesn't already
-have.  The kernel therefore stays opt-in (AL_TPU_KCENTER_PALLAS=1),
-kept as the scaffold for a future multi-pick batched variant — see
-DESIGN.md §5 — and the caller falls back to the XLA scan if the
-compiled kernel fails at runtime (strategies/kcenter.py).
+**Hardware history.**  The r5 A/B (v5e, three runs) showed the earlier
+PER-PICK matvec kernel at parity with the XLA scan (0.67x/1.11x/0.93x)
+— a [1, TILE_D] strip leaves the MXU idle and XLA's matvec is already
+HBM-bound.  That measurement is why the dispatcher
+(strategies/kcenter.py:_select_backend) only routes to this kernel in
+the BATCHED regime (Q >= CENTER_TILE, full tiles), where the Q-row MXU
+matmul plus the single fused pass has headroom the matvec never had;
+everywhere else it falls back to the XLA scan so ``pallas_x >= 1.0``
+holds by construction (the fallback is recorded, never silent — see
+LAST_BACKEND / LAST_FALLBACK_ERROR below).
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -45,15 +49,29 @@ from jax.experimental.pallas import tpu as pltpu
 
 TILE_N = 512
 TILE_D = 512
+# Centers are padded to a multiple of this (the float32 sublane tile):
+# a [CENTER_TILE, TILE_D] strip is the smallest left operand that keeps
+# the MXU fed, and padding with repeated centers leaves the min over
+# centers unchanged.
+CENTER_TILE = 8
 
-# Set by strategies/kcenter.py when the compiled kernel failed at runtime
-# and the XLA scan answered instead; bench.py's A/B checks it so a
-# fallback can never masquerade as a Pallas measurement.
+# Set by strategies/kcenter.py: which path actually answered the last
+# kcenter_greedy call ("xla" | "xla-batched" | "pallas" |
+# "pallas-interpret"), and the error when a compiled-kernel failure
+# forced the XLA fallback.  bench.py's A/B reads both so a fallback can
+# never masquerade as a Pallas measurement.
+LAST_BACKEND = None
 LAST_FALLBACK_ERROR = None
 
+# jax renamed TPUCompilerParams -> CompilerParams across versions; the
+# kernel must load on both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
 
-def _update_kernel(sqn_idx_ref, v_ref, xt_ref, sqn_ref, min_ref, out_ref,
-                   acc_ref):
+
+def _fused_kernel(sqn_c_ref, v_ref, xt_ref, sqn_ref, min_ref, sel_ref,
+                  out_min_ref, out_bmax_ref, out_barg_ref, acc_ref):
+    j = pl.program_id(0)
     k = pl.program_id(1)
 
     @pl.when(k == 0)
@@ -65,51 +83,89 @@ def _update_kernel(sqn_idx_ref, v_ref, xt_ref, sqn_ref, min_ref, out_ref,
 
     @pl.when(k == pl.num_programs(1) - 1)
     def _finish():
-        d_new = sqn_ref[:, :] + sqn_idx_ref[0, 0] - 2.0 * acc_ref[:, :]
-        out_ref[:, :] = jnp.minimum(min_ref[:, :], d_new)
+        # d[c, i] = ||x_i - center_c||^2 over the [Q, TILE_N] strip, its
+        # min over centers, the running-min update, and the block-local
+        # masked argmax — one VMEM-resident pass, nothing re-read.
+        d = sqn_c_ref[:, :] + sqn_ref[:, :] - 2.0 * acc_ref[:, :]
+        new_min = jnp.minimum(min_ref[:, :], jnp.min(d, axis=0,
+                                                     keepdims=True))
+        out_min_ref[:, :] = new_min
+        masked = jnp.where(sel_ref[:, :] > 0, new_min, -jnp.inf)
+        bmax = jnp.max(masked)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, TILE_N), 1)
+        # Lowest index among block maxima — jnp.argmax's tie-break, so
+        # the scan's global reduction reproduces XLA's pick exactly.
+        barg = jnp.min(jnp.where(masked >= bmax, lane,
+                                 jnp.int32(2 ** 31 - 1)))
+        out_bmax_ref[0, 0] = bmax
+        out_barg_ref[0, 0] = barg + j * TILE_N
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def min_dist_update(xt: jnp.ndarray, sqn: jnp.ndarray,
-                    min_dist: jnp.ndarray, idx: jnp.ndarray,
-                    interpret: bool = False) -> jnp.ndarray:
-    """One fused distance-update against pool row ``idx``.
+def fused_update_argmax(xt: jnp.ndarray, sqn: jnp.ndarray,
+                        min_dist: jnp.ndarray, selectable: jnp.ndarray,
+                        center_idxs: jnp.ndarray, interpret: bool = False):
+    """Fold ``center_idxs`` into the min-distance row and return the next
+    farthest point, in one pass over the pool tiles.
 
-    xt [D, N] float32 (transposed factors, N and D multiples of the
-    tiles); sqn [1, N]; min_dist [1, N]; idx scalar int32.  Returns the
-    updated [1, N] min-distance row.
+    xt [D, N] float32 (transposed factors; N, D tile multiples);
+    sqn / min_dist / selectable [1, N]; center_idxs [Q] int32 pool
+    indices with Q a CENTER_TILE multiple (pad with repeats — the min
+    over centers is unaffected).  Returns (new_min [1, N],
+    block_max [1, N/TILE_N], block_arg [1, N/TILE_N]); the global pick
+    is ``block_arg[0, argmax(block_max[0])]``.
     """
     d, n = xt.shape
+    q = center_idxs.shape[0]
     assert n % TILE_N == 0 and d % TILE_D == 0, (n, d)
-    v = jax.lax.dynamic_slice(xt, (0, idx), (d, 1)).T  # [1, D]
-    sqn_idx = jax.lax.dynamic_slice(sqn, (0, idx), (1, 1))  # [1, 1]
+    assert q % CENTER_TILE == 0, q
+    v = jnp.take(xt, center_idxs, axis=1).T  # [Q, D]
+    sqn_c = jnp.take(sqn[0], center_idxs)[:, None]  # [Q, 1]
 
     grid = (n // TILE_N, d // TILE_D)
     kwargs = {}
     if not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs["compiler_params"] = _CompilerParams(
             dimension_semantics=("parallel", "arbitrary"))
     return pl.pallas_call(
-        _update_kernel,
+        _fused_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1), lambda j, k: (0, 0)),          # sqn[idx]
-            pl.BlockSpec((1, TILE_D), lambda j, k: (0, k)),     # v
+            pl.BlockSpec((q, 1), lambda j, k: (0, 0)),            # sqn_c
+            pl.BlockSpec((q, TILE_D), lambda j, k: (0, k)),       # v
             pl.BlockSpec((TILE_D, TILE_N), lambda j, k: (k, j)),  # XT
-            pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),     # sqn
-            pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),     # min_dist
+            pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),       # sqn
+            pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),       # min_dist
+            pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),       # selectable
         ],
-        out_specs=pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((1, TILE_N), jnp.float32)],
+        out_specs=[
+            pl.BlockSpec((1, TILE_N), lambda j, k: (0, j)),
+            pl.BlockSpec((1, 1), lambda j, k: (0, j)),
+            pl.BlockSpec((1, 1), lambda j, k: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n // TILE_N), jnp.float32),
+            jax.ShapeDtypeStruct((1, n // TILE_N), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((q, TILE_N), jnp.float32)],
         interpret=interpret,
         **kwargs,
-    )(sqn_idx, v, xt, sqn, min_dist)
+    )(sqn_c, v, xt, sqn, min_dist, selectable)
+
+
+def pad_centers(idxs: jnp.ndarray) -> jnp.ndarray:
+    """Pad a [q] center-index vector to the CENTER_TILE multiple with
+    repeats of the first entry (min over duplicate centers is a no-op)."""
+    q = idxs.shape[0]
+    pad = (-q) % CENTER_TILE
+    if pad:
+        idxs = jnp.concatenate([idxs, jnp.broadcast_to(idxs[:1], (pad,))])
+    return idxs
 
 
 def pad_to_tiles(x: jnp.ndarray) -> jnp.ndarray:
     """Pad an [N, D] factor matrix with zero rows/cols to tile multiples
-    and return it TRANSPOSED as [D_pad, N_pad] for min_dist_update.
+    and return it TRANSPOSED as [D_pad, N_pad] for fused_update_argmax.
     Zero-padded pool rows have distance sqn[idx] - 0 >= 0 to everything
     and must be masked ineligible by the caller (kcenter does, via its
     ``selectable`` vector)."""

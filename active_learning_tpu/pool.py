@@ -17,6 +17,34 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 
+def bucket_size(n: int, floor: int = 256) -> int:
+    """Bounded-waste geometric bucket: ``n`` rounded up to a multiple of
+    1/8 of its enclosing power of two (never below ``floor``).
+
+    THE shape-bucketing rule for everything whose size tracks the growing
+    labeled set (or the shrinking unlabeled pool) across AL rounds: the
+    trainer's epoch-scan step count, its device-resident row upload, and
+    the k-center selection pool are all padded to this bucket so round
+    N+1 reuses round N's compiled executables instead of paying a fresh
+    XLA compile per round (padding is masked out of every computation by
+    the callers).
+
+    Why not plain next-power-of-two: the padding is masked out of the
+    RESULTS but not the COMPUTE — a padded epoch-scan step still runs a
+    full train step, a padded pool row still rides every distance matmul
+    — so just past a pow2 boundary pure pow2 buckets would re-spend up
+    to ~2x compute on EVERY epoch/pick to save one recompile per round.
+    The 1/8-octave granularity caps that recurring waste at 25%
+    worst-case (just past a power of two; typically well under 10%)
+    while keeping the distinct-shape count small (8 buckets per
+    doubling) so consecutive rounds still reuse executables.  ``floor``
+    pins tiny inputs to one fixed bucket.
+    """
+    n = max(int(n), int(floor))
+    gran = max(int(floor), (1 << (n - 1).bit_length()) // 8)
+    return -(-n // gran) * gran
+
+
 @dataclasses.dataclass
 class PoolState:
     """Boolean-mask view of the unlabeled pool.

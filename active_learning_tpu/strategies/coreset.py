@@ -11,8 +11,9 @@ O(N^2) distance matrix, arXiv:2107.14263).
 TPU-first differences (see strategies/kcenter.py for the math):
   * the embedding / gradient-embedding pass is mesh-parallel
     (strategies/scoring.py) instead of a single-GPU loader walk;
-  * the greedy selection runs as one on-device ``lax.scan`` over factorized
-    embeddings — the N x N matrix the reference materializes
+  * the greedy selection runs fully on device over factorized embeddings
+    (batched farthest-first, q picks per pool pass — cfg.kcenter_batch)
+    — the N x N matrix the reference materializes
     (coreset_sampler.py:59-64) never exists, which also removes the reason
     partitioning was mandatory at ImageNet scale (it remains supported for
     parity and for bounding the embedding pass itself).
@@ -111,7 +112,9 @@ class CoresetSampler(Strategy):
         labeled_mask = self.already_labeled_mask()[idxs_for_coreset]
         budget = int(min(len(idxs_for_query), budget))
         picks = kcenter_greedy(factors, labeled_mask, budget,
-                               randomize=self.randomize, rng=self.rng)
+                               randomize=self.randomize, rng=self.rng,
+                               batch_q=self.cfg.kcenter_batch,
+                               mesh=self.mesh)
         selected = idxs_for_coreset[picks]
         assert len(np.unique(selected)) == len(selected), (
             "k-center selected a duplicate index")
@@ -177,7 +180,9 @@ class PartitionedCoresetSampler(CoresetSampler):
             labeled_mask = np.zeros(len(part), dtype=bool)
             labeled_mask[:len(labeled_parts[i])] = True
             picks = kcenter_greedy(factors, labeled_mask, cur_budget,
-                                   randomize=self.randomize, rng=self.rng)
+                                   randomize=self.randomize, rng=self.rng,
+                                   batch_q=self.cfg.kcenter_batch,
+                                   mesh=self.mesh)
             selected.append(part[picks])
 
         selected = (np.sort(np.concatenate(selected)) if selected

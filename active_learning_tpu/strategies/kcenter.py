@@ -7,8 +7,8 @@ step, recomputes the min over all labeled columns
 O(budget * N * L) work, with a host round-trip per step.
 
 The TPU design keeps only the factor matrices and a length-N min-distance
-vector on device and runs the whole selection as ONE ``lax.scan`` of
-``budget`` steps — no N x N matrix, no per-step host sync:
+vector on device and runs the whole selection on device — no N x N
+matrix, no per-step host sync:
 
   * Embeddings are a tuple of FACTOR matrices.  Plain coreset is one factor
     X [N, D] with dot(i,j) = X_i . X_j.  BADGE's gradient embedding
@@ -19,10 +19,44 @@ vector on device and runs the whole selection as ONE ``lax.scan`` of
     rank-1 (the mean over a bin rectangle of a_c * e_d is the product of
     the two bin means), so the pooled variant (badge_sampler.py:41-44)
     keeps the same factorized form.
-  * Each scan step does one fused [N, K] matvec per factor plus an
-    argmax/categorical draw, then the incremental min-distance update
-    min_dist <- min(min_dist, d(., new)) — equivalent to the reference's
-    full recomputation because min over a growing set is associative.
+  * Deterministic selection runs BATCHED: each step takes the top-q
+    provisionally-farthest candidates, verifies them with an exact
+    in-batch re-check (below), and folds all accepted picks into the
+    min-distance vector with ONE [N, q] pass — the pool is read once per
+    q picks instead of once per pick, and under a pool-sharded layout the
+    strip min is shard-local so each step needs a single cross-shard
+    reduction (see scoring.batched_min_dist_update).
+  * The randomized (k-means++ D^2) mode stays one pick per step — a
+    batched draw would change the sampling distribution.
+
+**Batched farthest-first is exact.**  Let v_1 >= ... >= v_q be the top-q
+current min-distances and T = v_q.  Candidate picks are accepted one at a
+time in-batch: each sub-step recomputes the remaining candidates' exact
+min-distances against the already-accepted picks (a [q, q] table — tiny)
+and accepts the maximum iff it exceeds T strictly.  Every non-candidate's
+distance only shrinks as picks accrue and started <= T, so an accepted
+candidate dominates the whole pool — the pick sequence is identical to
+q=1 greedy (pinned in tests/test_kcenter.py).  When the re-check fails
+the step stops early; progress is still >= 1 pick (the first candidate is
+the unbatched argmax).
+
+**Dispatch.**  ``_select_backend`` routes between the XLA scans and the
+fused Pallas kernel (ops/kcenter_pallas.py) by measured block-size
+heuristics, not a flag: the r5 hardware A/B showed the per-pick matvec
+kernel at parity with XLA (0.67-1.11x), so Pallas is only chosen in the
+batched full-tile regime where the [q, TILE] MXU matmul plus the single
+fused update+argmax pass has real headroom; everywhere else the XLA scan
+answers and ``pallas_x >= 1.0`` holds by construction.
+``AL_TPU_KCENTER_PALLAS`` overrides: "1" forces the kernel, "0" forces
+XLA, "interpret" runs the kernel in interpret mode (CPU tests).
+
+Pool shapes are padded to bounded-waste geometric buckets
+(pool.bucket_size: 1/8-octave granularity — padded rows ride every
+distance matmul, so the recurring compute waste stays bounded, 25%
+worst-case) before the jitted scans, so subset-capped pools whose size
+drifts across AL rounds reuse the previous round's executables; the
+distance / selectable carries are donated, so each step updates them in
+place.
 
 Distances are SQUARED L2 throughout, matching the reference (it never
 takes a sqrt; the randomized mode's selection probabilities are therefore
@@ -39,7 +73,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel import mesh as mesh_lib
+from ..pool import bucket_size
+
+try:  # pallas may be absent on exotic jax builds; the XLA scans never are
+    from ..ops import kcenter_pallas as kp
+except Exception:  # pragma: no cover - environment-dependent
+    kp = None
+
 Factors = Tuple[jnp.ndarray, ...]
+
+# Default q for the batched deterministic greedy: one CENTER_TILE of the
+# fused kernel (8 = the f32 sublane tile), the smallest batch that both
+# cuts scan steps ~8x and fills an MXU strip.  Overridden per experiment
+# via ExperimentConfig.kcenter_batch.
+DEFAULT_BATCH_Q = 8
+
+# Pools are padded to the enclosing geometric bucket (>= this floor) so
+# the jitted scans compile once per BUCKET, not once per subset-capped
+# pool size; padded rows are zero factors masked out via ``selectable``.
+POOL_BUCKET_FLOOR = 256
 
 
 def self_sq_norms(factors: Factors) -> jnp.ndarray:
@@ -69,6 +122,16 @@ def dots_to_many(factors: Factors, idxs) -> jnp.ndarray:
     return out
 
 
+def dots_between(factors: Factors, idxs) -> jnp.ndarray:
+    """g_i . g_j for i, j in idxs — [K, K] (the batched re-check table)."""
+    out = None
+    for f in factors:
+        rows = f[idxs]
+        d = rows @ rows.T
+        out = d if out is None else out * d
+    return out
+
+
 @functools.partial(jax.jit, donate_argnums=(3,))
 def _min_dist_chunk(factors: Factors, sqn: jnp.ndarray, chunk: jnp.ndarray,
                     min_dist: jnp.ndarray) -> jnp.ndarray:
@@ -93,12 +156,14 @@ def min_sq_dist_to(factors: Factors, sqn: jnp.ndarray,
     return min_dist
 
 
-@functools.partial(jax.jit, static_argnames=("budget", "randomize"))
+@functools.partial(jax.jit, static_argnames=("budget", "randomize"),
+                   donate_argnums=(2, 3))
 def _kcenter_scan(factors: Factors, sqn: jnp.ndarray, min_dist: jnp.ndarray,
                   selectable: jnp.ndarray, budget: int, randomize: bool,
                   key: jax.Array) -> jnp.ndarray:
-    """The greedy loop as one scan.  ``selectable`` is 1.0 on unlabeled
-    rows; labeled rows have min_dist ~ 0 so the deterministic argmax never
+    """The q=1 greedy loop as one scan (randomized mode, and the batched
+    path's degenerate case).  ``selectable`` is 1.0 on unlabeled rows;
+    labeled rows have min_dist ~ 0 so the deterministic argmax never
     picks them (mirroring the reference, which also relies on that)."""
 
     def step(carry, key):
@@ -127,28 +192,160 @@ def _kcenter_scan(factors: Factors, sqn: jnp.ndarray, min_dist: jnp.ndarray,
     return picks
 
 
-@functools.partial(jax.jit, static_argnames=("budget", "interpret"))
-def _kcenter_scan_pallas(xt, sqn_row, min_dist_row, selectable, budget: int,
+def _recheck_candidates(cands: jnp.ndarray, vals: jnp.ndarray,
+                        d_cc: jnp.ndarray, limit: jnp.ndarray,
+                        sentinel: int):
+    """Exact in-batch acceptance over the top-q candidates (see module
+    docstring).  ``cands``/``vals`` come from top_k of the masked
+    min-distances (descending, ties lowest-index first — matching
+    argmax); ``d_cc`` is the [q, q] candidate pairwise distance table;
+    ``limit`` caps accepted picks (budget remainder).  Returns
+    (order [q] of candidate POSITIONS in acceptance order, n_acc)."""
+    q = cands.shape[0]
+    thresh = vals[q - 1]
+
+    def body(_, st):
+        cur, accepted, order, n_acc, last, stop = st
+        cur = jnp.minimum(cur, d_cc[:, last])
+        avail = jnp.where(accepted, -jnp.inf, cur)
+        m = jnp.max(avail)
+        # Lowest POOL index among in-batch maxima: the q=1 argmax's
+        # tie-break, so batched picks replay the sequential order.
+        p = jnp.argmin(jnp.where(avail >= m, cands, sentinel))
+        # Strict > T: at == T a non-candidate could tie and win the q=1
+        # argmax by index — stop and let the next step re-rank the pool.
+        ok = (m > thresh) & (~stop) & (n_acc < limit)
+        accepted = accepted.at[p].set(accepted[p] | ok)
+        order = jnp.where(ok, order.at[n_acc].set(p.astype(jnp.int32)),
+                          order)
+        last = jnp.where(ok, p, last)
+        n_acc = n_acc + ok.astype(jnp.int32)
+        return (cur, accepted, order, n_acc, last, stop | ~ok)
+
+    init = (vals, jnp.zeros(q, bool).at[0].set(True),
+            jnp.zeros(q, jnp.int32), jnp.int32(1), jnp.int32(0),
+            jnp.asarray(False))
+    _, _, order, n_acc, _, _ = jax.lax.fori_loop(0, q - 1, body, init)
+    return order, n_acc
+
+
+def _accept_pick_batch(masked: jnp.ndarray, q: int, limit, sentinel: int,
+                       pair_dists):
+    """One batched-greedy candidate round, shared verbatim by the XLA and
+    Pallas scan bodies so their pick semantics can never drift: masked
+    top-q, exact in-batch re-check, and the padded accepted sequence
+    (unaccepted slots repeat the first pick — the min-fold is a no-op for
+    duplicates and the next step overwrites their pick slots).
+    ``pair_dists(cands) -> [q, q]`` supplies the candidate pairwise
+    squared distances in whichever factor layout the caller holds.
+    Returns (seq [q] pool indices, n_acc)."""
+    vals, cands = jax.lax.top_k(masked, q)
+    order, n_acc = _recheck_candidates(cands, vals, pair_dists(cands),
+                                       limit, sentinel)
+    slot = jnp.arange(q)
+    seq = jnp.where(slot < n_acc, cands[order], cands[order[0]])
+    return seq, n_acc
+
+
+@functools.partial(jax.jit, static_argnames=("budget", "q"),
+                   donate_argnums=(2, 3))
+def _kcenter_scan_batched(factors: Factors, sqn: jnp.ndarray,
+                          min_dist: jnp.ndarray, selectable: jnp.ndarray,
+                          budget: int, q: int) -> jnp.ndarray:
+    """Batched deterministic greedy: top-q candidates, exact re-check,
+    one fused [N, q] distance pass per accepted batch.  Pick-for-pick
+    identical to the q=1 scan; ~q x fewer pool reads."""
+    from . import scoring
+
+    n = sqn.shape[0]
+    # q trailing slots absorb the final step's padded writes; sliced off.
+    picks0 = jnp.zeros(budget + q, jnp.int32)
+
+    def cond(st):
+        return st[3] < budget
+
+    def pair_dists(cands):
+        return (sqn[cands][:, None] + sqn[cands][None, :]
+                - 2.0 * dots_between(factors, cands))
+
+    def body(st):
+        min_dist, selectable, picks, count = st
+        masked = jnp.where(selectable > 0, min_dist, -jnp.inf)
+        seq, n_acc = _accept_pick_batch(
+            masked, q, jnp.minimum(q, budget - count), n, pair_dists)
+        min_dist = scoring.batched_min_dist_update(factors, sqn, min_dist,
+                                                   seq)
+        selectable = selectable.at[seq].set(0.0)
+        picks = jax.lax.dynamic_update_slice(picks, seq.astype(jnp.int32),
+                                             (count,))
+        return (min_dist, selectable, picks, count + n_acc)
+
+    _, _, picks, _ = jax.lax.while_loop(
+        cond, body, (min_dist, selectable, picks0, jnp.int32(0)))
+    return picks[:budget]
+
+
+@functools.partial(jax.jit, static_argnames=("budget", "interpret"),
+                   donate_argnums=(2, 3))
+def _kcenter_scan_pallas(xt, sqn_row, min_row, sel_row, budget: int,
                          interpret: bool) -> jnp.ndarray:
-    """Deterministic single-factor scan with the fused Pallas distance
-    update (ops/kcenter_pallas.py): identical pick semantics to
-    _kcenter_scan — argmax over the CURRENT min-distances, then one
-    fused pass updates them against the pick.  Opt-in via
-    AL_TPU_KCENTER_PALLAS (see kcenter_greedy)."""
-    from ..ops import kcenter_pallas as kp
+    """q=1 deterministic scan on the fused Pallas kernel: each step folds
+    the previous pick into the min-distances AND finds the next pick in
+    the same pass over the pool tiles (ops/kcenter_pallas.py), so the
+    pool is read once per pick instead of twice.  Pick semantics match
+    _kcenter_scan exactly (argmax of the CURRENT min-distances)."""
+
+    idx0 = jnp.argmax(jnp.where(sel_row[0] > 0, min_row[0],
+                                -jnp.inf)).astype(jnp.int32)
 
     def step(carry, _):
-        min_dist_row, selectable = carry
-        idx = jnp.argmax(jnp.where(selectable > 0, min_dist_row[0],
-                                   -jnp.inf)).astype(jnp.int32)
-        min_dist_row = kp.min_dist_update(xt, sqn_row, min_dist_row, idx,
-                                          interpret=interpret)
-        selectable = selectable.at[idx].set(0.0)
-        return (min_dist_row, selectable), idx
+        min_row, sel_row, idx = carry
+        sel_row = sel_row.at[0, idx].set(0.0)
+        centers = jnp.full((kp.CENTER_TILE,), idx, jnp.int32)
+        min_row, bmax, barg = kp.fused_update_argmax(
+            xt, sqn_row, min_row, sel_row, centers, interpret=interpret)
+        nxt = barg[0, jnp.argmax(bmax[0])]
+        return (min_row, sel_row, nxt), idx
 
-    _, picks = jax.lax.scan(step, (min_dist_row, selectable), None,
+    _, picks = jax.lax.scan(step, (min_row, sel_row, idx0), None,
                             length=budget)
     return picks
+
+
+@functools.partial(jax.jit, static_argnames=("budget", "q", "interpret"),
+                   donate_argnums=(2, 3))
+def _kcenter_scan_batched_pallas(xt, sqn_row, min_row, sel_row, budget: int,
+                                 q: int, interpret: bool) -> jnp.ndarray:
+    """Batched greedy with the fused Pallas distance update: same
+    top-q + exact re-check as _kcenter_scan_batched, with the [N, q]
+    fold running as one kernel pass over the transposed pool tiles."""
+    n = sqn_row.shape[1]
+    picks0 = jnp.zeros(budget + q, jnp.int32)
+
+    def cond(st):
+        return st[3] < budget
+
+    def pair_dists(cands):
+        rows = jnp.take(xt, cands, axis=1).T  # xt columns are pool rows
+        sqn_c = sqn_row[0, cands]
+        return sqn_c[:, None] + sqn_c[None, :] - 2.0 * (rows @ rows.T)
+
+    def body(st):
+        min_row, sel_row, picks, count = st
+        masked = jnp.where(sel_row[0] > 0, min_row[0], -jnp.inf)
+        seq, n_acc = _accept_pick_batch(
+            masked, q, jnp.minimum(q, budget - count), n, pair_dists)
+        sel_row = sel_row.at[0, seq].set(0.0)
+        min_row, _, _ = kp.fused_update_argmax(
+            xt, sqn_row, min_row, sel_row,
+            kp.pad_centers(seq.astype(jnp.int32)), interpret=interpret)
+        picks = jax.lax.dynamic_update_slice(picks, seq.astype(jnp.int32),
+                                             (count,))
+        return (min_row, sel_row, picks, count + n_acc)
+
+    _, _, picks, _ = jax.lax.while_loop(
+        cond, body, (min_row, sel_row, picks0, jnp.int32(0)))
+    return picks[:budget]
 
 
 @functools.partial(jax.jit, static_argnames=("block",))
@@ -171,17 +368,54 @@ def _minimax_row(factors: Factors, sqn: jnp.ndarray, block: int = 2048
     return jnp.argmin(row_max)
 
 
+def _select_backend(n_pad: int, dim: int, n_factors: int, randomize: bool,
+                    q: int) -> str:
+    """Route between the XLA scans and the fused Pallas kernel.
+
+    The heuristic encodes the r5 hardware A/B (ops/kcenter_pallas.py
+    docstring): the kernel only wins when its MXU strips are FULL — a
+    CENTER_TILE of batched picks, at least one full TILE_D of features,
+    and enough TILE_N blocks that the parallel grid dimension amortizes
+    launch overhead.  Everything else takes the XLA scan, so a Pallas
+    choice is a claim the kernel should measure >= 1.0x
+    (bench.py asserts it).  AL_TPU_KCENTER_PALLAS: "1" force-on, "0"
+    force-off, "interpret" force-on in interpret mode (CPU tests),
+    unset/"" = this heuristic.
+    """
+    if kp is None or n_factors != 1 or randomize:
+        return "xla"
+    mode = os.environ.get("AL_TPU_KCENTER_PALLAS", "")
+    if mode == "0":
+        return "xla"
+    if mode == "interpret":
+        return "pallas-interpret"
+    if mode == "1":
+        return "pallas"
+    if jax.default_backend() != "tpu":
+        return "xla"
+    if q < kp.CENTER_TILE or dim < kp.TILE_D or n_pad < 8 * kp.TILE_N:
+        return "xla"
+    return "pallas"
+
+
 def kcenter_greedy(
     factors: Sequence[np.ndarray],
     labeled_mask: np.ndarray,
     budget: int,
     randomize: bool = False,
     rng: Optional[np.random.Generator] = None,
+    batch_q: Optional[int] = None,
+    mesh=None,
 ) -> np.ndarray:
     """Select ``budget`` local row indices by greedy k-center over the
     factorized embeddings.  Matches coreset_sampler.coreset(:66-105):
-    deterministic mode takes the farthest-point argmax; randomized mode
-    draws with D^2 probabilities.  Returns selections in pick order."""
+    deterministic mode takes the farthest-point argmax (batched q picks
+    per pool pass, pick-for-pick identical — see module docstring);
+    randomized mode draws with D^2 probabilities one pick at a time.
+    ``mesh``: optional single-process device mesh; when given, the pool
+    axis is sharded over its data axis so the per-step distance pass and
+    strip-min run shard-local (one cross-shard reduction per step).
+    Returns selections in pick order."""
     factors = tuple(jnp.asarray(np.asarray(f), dtype=jnp.float32)
                     for f in factors)
     labeled_mask = np.asarray(labeled_mask, dtype=bool)
@@ -207,60 +441,98 @@ def kcenter_greedy(
         labeled_idxs = np.asarray([seed_idx])
         budget -= 1
 
+    if budget <= 0:
+        return np.asarray(picks_pre, dtype=np.int64)
+
+    q = 1 if randomize else int(batch_q or DEFAULT_BATCH_Q)
+    q = max(1, min(q, budget))
+
+    # Power-of-two pool bucketing: subset-capped pools drift in size
+    # across AL rounds; padding to the enclosing bucket (zero factor
+    # rows, selectable 0 — they can never win an argmax, a top-k
+    # acceptance, or a D^2 draw) lets round N+1 reuse round N's compiled
+    # executables instead of paying a fresh XLA compile.  Applied BEFORE
+    # the initial min pass so the chunked _min_dist_chunk reuses too
+    # (only the once-per-experiment minimax seed above runs unpadded — a
+    # zero pad row could win ITS argmin).
+    n_pad = bucket_size(n, floor=POOL_BUCKET_FLOOR)
+    pad = n_pad - n
+    if pad:
+        factors = tuple(jnp.pad(f, ((0, pad), (0, 0))) for f in factors)
+        sqn = jnp.pad(sqn, (0, pad))
     min_dist = min_sq_dist_to(factors, sqn, labeled_idxs)
-    selectable = np.ones(n, dtype=np.float32)
+    selectable = np.zeros(n_pad, dtype=np.float32)
+    selectable[:n] = 1.0
     selectable[labeled_idxs] = 0.0
-    # Opt-in fused Pallas update for the deterministic single-factor scan
-    # (AL_TPU_KCENTER_PALLAS=1 on TPU, =interpret for CPU testing) — same
-    # picks, one fused HBM pass per step; see ops/kcenter_pallas.py and
-    # DESIGN.md §5 for why this stays opt-in.
-    pallas_mode = os.environ.get("AL_TPU_KCENTER_PALLAS", "")
-    use_pallas = (budget > 0 and not randomize and len(factors) == 1
-                  and pallas_mode in ("1", "interpret"))
+
+    backend = _select_backend(n_pad, factors[0].shape[1], len(factors),
+                              randomize, q)
+    if kp is not None:
+        kp.LAST_BACKEND = backend
+        kp.LAST_FALLBACK_ERROR = None
     picks = None
-    if use_pallas:
+    if backend.startswith("pallas"):
+        interpret = backend == "pallas-interpret"
         try:
-            from ..ops import kcenter_pallas as kp
             xt = kp.pad_to_tiles(factors[0])
-            n_pad = xt.shape[1]
-            sqn_row = jnp.zeros((1, n_pad), jnp.float32).at[0, :n].set(sqn)
-            md_row = jnp.full((1, n_pad), jnp.inf,
-                              jnp.float32).at[0, :n].set(min_dist)
-            sel = jnp.zeros(n_pad, jnp.float32).at[:n].set(
+            n_tile = xt.shape[1]
+            sqn_row = jnp.zeros((1, n_tile), jnp.float32).at[0, :n_pad].set(
+                sqn)
+            md_row = jnp.full((1, n_tile), jnp.inf,
+                              jnp.float32).at[0, :n_pad].set(min_dist)
+            sel_row = jnp.zeros((1, n_tile), jnp.float32).at[0, :n_pad].set(
                 jnp.asarray(selectable))
-            picks = np.asarray(
-                _kcenter_scan_pallas(xt, sqn_row, md_row, sel, budget,
-                                     pallas_mode == "interpret"),
-                dtype=np.int64)
+            if q > 1:
+                picks = np.asarray(
+                    _kcenter_scan_batched_pallas(xt, sqn_row, md_row,
+                                                 sel_row, budget, q,
+                                                 interpret),
+                    dtype=np.int64)
+            else:
+                picks = np.asarray(
+                    _kcenter_scan_pallas(xt, sqn_row, md_row, sel_row,
+                                         budget, interpret),
+                    dtype=np.int64)
         except Exception as e:
             # A compiled-kernel failure on real hardware (tiling limits,
             # pltpu API drift) must degrade to the XLA scan, not kill the
             # experiment mid-round.  In interpret mode (CI) the opposite:
             # a silent fallback would make the pick-equality pin test
             # compare XLA to XLA and pass vacuously — re-raise there.
-            if pallas_mode == "interpret":
+            if interpret:
                 raise
+            kp.LAST_BACKEND = "xla"
+            kp.LAST_FALLBACK_ERROR = repr(e)  # bench A/B reads this
             from ..utils.logging import get_logger
-            try:
-                # The failure may BE this module's import (pltpu missing
-                # on an exotic jax build) — the marker is best-effort, the
-                # fallback is not.
-                from ..ops import kcenter_pallas as kp
-                kp.LAST_FALLBACK_ERROR = repr(e)  # bench A/B reads this
-            except ImportError:
-                pass
             get_logger().warning(
                 f"Pallas k-center update failed ({e!r}); falling back to "
                 "the XLA scan")
     if picks is None:
-        if budget > 0:
-            picks = np.asarray(
-                _kcenter_scan(factors, sqn, min_dist,
-                              jnp.asarray(selectable), budget,
-                              bool(randomize), key),
-                dtype=np.int64)
+        if (mesh is not None and mesh.devices.size > 1
+                and not mesh_lib.is_multiprocess(mesh)
+                and n_pad % mesh.devices.size == 0):
+            # Shard the pool axis over the mesh: the per-step [N, q]
+            # distance pass, strip min, and running-min update all run
+            # shard-local; the top-k / argmax is the step's one
+            # cross-shard reduction.  Exact — min/max reductions do no
+            # rounding and each row's matvec stays on one shard.
+            sh = mesh_lib.batch_sharding(mesh)
+            factors = tuple(jax.device_put(f, sh) for f in factors)
+            sqn = jax.device_put(sqn, sh)
+            min_dist = jax.device_put(min_dist, sh)
+            sel_dev = jax.device_put(jnp.asarray(selectable), sh)
         else:
-            picks = np.zeros(0, dtype=np.int64)
+            sel_dev = jnp.asarray(selectable)
+        if q > 1:
+            picks = np.asarray(
+                _kcenter_scan_batched(factors, sqn, min_dist, sel_dev,
+                                      budget, q), dtype=np.int64)
+            if kp is not None and kp.LAST_BACKEND == "xla":
+                kp.LAST_BACKEND = "xla-batched"
+        else:
+            picks = np.asarray(
+                _kcenter_scan(factors, sqn, min_dist, sel_dev, budget,
+                              bool(randomize), key), dtype=np.int64)
     return np.concatenate([np.asarray(picks_pre, dtype=np.int64), picks])
 
 

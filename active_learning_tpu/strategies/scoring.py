@@ -33,6 +33,29 @@ from ..data.pipeline import (batch_index_lists, iterate_batches,
                              padded_batch_layout)
 
 
+def batched_min_dist_update(factors, sqn: jnp.ndarray,
+                            min_dist: jnp.ndarray,
+                            center_idxs: jnp.ndarray) -> jnp.ndarray:
+    """One batched k-center distance fold: min_dist <- min(min_dist,
+    min_c ||g_. - g_c||^2) over the q centers in ``center_idxs``, in a
+    single [N, q] pass over the factor matrices.
+
+    This is the selection hot path's per-step min-reduce, and it lives
+    here with the other mesh-parallel scoring primitives because its
+    operands follow the pool-axis layout collect_pool produces: with the
+    pool axis sharded over the mesh's data axis the [shard, q] distance
+    strip, its min over q, and the running-min update are all
+    shard-local — the batched greedy step's only cross-shard reduction
+    is the subsequent masked top-k, ONE collective per q picks instead
+    of one per pick (strategies/kcenter.py wires the sharding).
+    """
+    from .kcenter import dots_to_many
+
+    d = (sqn[:, None] + sqn[center_idxs][None, :]
+         - 2.0 * dots_to_many(factors, center_idxs))
+    return jnp.minimum(min_dist, jnp.min(d, axis=1))
+
+
 def make_prob_stats_step(model, view: ViewSpec) -> Callable:
     """Per-example softmax statistics in one fused pass: top-1 probability
     (ConfidenceSampler's score, confidence_sampler.py:33-36), top1-top2

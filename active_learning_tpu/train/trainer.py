@@ -272,10 +272,23 @@ class Trainer:
 
         return epoch_scan
 
-    # Steps (and uploaded rows) are bucketed to multiples of this so the
-    # epoch scan compiles once per BUCKET, not once per AL round as the
-    # labeled set grows; the padding steps are masked out inside the scan.
+    # Steps (and uploaded rows) are bucketed so the epoch scan compiles
+    # once per BUCKET, not once per AL round as the labeled set grows:
+    # up to STEP_BUCKET steps everything lands on the one floor bucket,
+    # beyond it steps round up to a bounded-waste geometric bucket
+    # (pool.bucket_size, 1/8-octave granularity).  Padded steps are
+    # masked out of the RESULTS (``valid``) but still execute the train
+    # step, so the bucket rule bounds that recurring per-epoch waste
+    # (25% worst-case, typically a few %) — pure power-of-two buckets
+    # would re-spend up to ~2x compute every epoch just past a boundary
+    # to save one recompile per round.  Bucket size never changes
+    # numerics.
     STEP_BUCKET = 16
+
+    @classmethod
+    def bucket_steps(cls, steps_real: int) -> int:
+        from ..pool import bucket_size
+        return bucket_size(steps_real, floor=cls.STEP_BUCKET)
 
     def _device_resident_arrays(self, train_set: Dataset,
                                 labeled_idxs: np.ndarray, batch_size: int):
@@ -284,8 +297,8 @@ class Trainer:
         per-step gather output is what gets data-sharded)."""
         images = train_set.gather(labeled_idxs)
         labels = train_set.targets[labeled_idxs].astype(np.int32)
-        row_bucket = self.STEP_BUCKET * batch_size
-        padded = -(-len(labeled_idxs) // row_bucket) * row_bucket
+        padded = self.bucket_steps(
+            num_batches(len(labeled_idxs), batch_size)) * batch_size
         pad = padded - len(labeled_idxs)
         if pad:
             images = np.concatenate(
@@ -312,7 +325,7 @@ class Trainer:
         mask = np.ones(steps_real * batch_size, dtype=np.float32)
         if pad:
             mask[n:] = 0.0
-        steps = -(-steps_real // cls.STEP_BUCKET) * cls.STEP_BUCKET
+        steps = cls.bucket_steps(steps_real)
         idx_mat = np.zeros((steps, batch_size), dtype=np.int32)
         mask_mat = np.zeros((steps, batch_size), dtype=np.float32)
         idx_mat[:steps_real] = perm.reshape(steps_real, batch_size)

@@ -12,7 +12,10 @@ Eight phases, bfloat16 over the full local mesh:
     decoder into the mesh scoring pass (per-core decode rate, h2d
     bandwidth, end-to-end images/sec).
   * kcenter_select — greedy selection at protocol scale (10k picks over a
-    [50k, 2048] pool), with an A/B of the opt-in Pallas fused update.
+    [50k, 2048] pool) through the production batched-greedy path with
+    auto Pallas/XLA dispatch, plus a forced-backend A/B that asserts the
+    dispatcher's choice (pallas_x >= 1.0 whenever Pallas was chosen —
+    a violation is recorded as pallas_regression).
   * al_round_cifar / al_round_imagenet — BASELINE.md metric #1: one REAL
     end-to-end AL round (query -> train -> test) through the production
     driver (experiment/driver.py), with the per-phase wall-clock the
@@ -456,16 +459,28 @@ def _datapath_model_passes(result, dataset, cached_set, batch_size,
 
 def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
                       ) -> dict:
-    """Greedy k-center selection at the paper's protocol scale: one
-    ``budget``-step lax.scan over a [50k, 2048] embedding pool (the
-    reference's subset cap, gen_jobs.py:8-13; its host loop does one
-    np.random.choice + full-matrix min per pick, coreset_sampler.py:66-105).
-    Reports picks/sec; "ips" carries picks/sec so the parent's schema
-    checks hold (unit field says which)."""
+    """Greedy k-center selection at the paper's protocol scale over a
+    [50k, 2048] embedding pool (the reference's subset cap,
+    gen_jobs.py:8-13; its host loop does one np.random.choice +
+    full-matrix min per pick, coreset_sampler.py:66-105).  Times the
+    PRODUCTION path: batched farthest-first (q = DEFAULT_BATCH_Q picks
+    per pool pass) with the dispatcher auto-selecting Pallas vs the XLA
+    scan (strategies/kcenter.py); the chosen backend is recorded so a
+    fallback is attributable.  Reports picks/sec; "ips" carries
+    picks/sec so the parent's schema checks hold (unit field says
+    which)."""
     import numpy as np
 
     import jax
-    from active_learning_tpu.strategies.kcenter import kcenter_greedy
+    from active_learning_tpu.strategies.kcenter import (DEFAULT_BATCH_Q,
+                                                        kcenter_greedy)
+    try:
+        # Same guard as strategies/kcenter.py: on jax builds without a
+        # usable pallas the XLA selection path still works and must
+        # still be timed — only the backend attribution goes missing.
+        from active_learning_tpu.ops import kcenter_pallas as kp
+    except Exception:
+        kp = None
 
     device_kind = jax.devices()[0].device_kind
     log(f"[kcenter_select] pool [{pool_n}, {dim}], budget {budget} on "
@@ -495,6 +510,8 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
         "pool_n": pool_n,
         "dim": dim,
         "budget": budget,
+        "batch_q": DEFAULT_BATCH_Q,
+        "backend": getattr(kp, "LAST_BACKEND", None) if kp else "xla",
         "select_sec": round(dt, 2),
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
@@ -509,16 +526,25 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
     return result, picks
 
 
-def run_kcenter_pallas_ab(budget: int, xla_result: dict,
-                          xla_picks, dim: int = 2048,
+def run_kcenter_pallas_ab(budget: int, auto_result: dict,
+                          auto_picks, dim: int = 2048,
                           pool_n: int = 50000):
-    """A/B the opt-in fused Pallas distance-update (ops/kcenter_pallas.py)
-    against the XLA scan just measured.  ``xla_picks`` is the timed
-    phase's pick sequence (deterministic mode ignores the PRNG key), the
-    baseline for the on-hardware pick-equality check the interpret-mode
-    tests cannot provide (MXU accumulation order differs; an argmax tie
-    could flip a pick).  TPU only; failures are recorded, never fatal —
-    the XLA number is already with the parent."""
+    """A/B the fused Pallas kernel against the XLA scan around the
+    dispatcher's auto choice (strategies/kcenter.py:_select_backend).
+
+    The phase just timed the PRODUCTION path; this measures the road not
+    taken — forced XLA when auto chose Pallas, forced Pallas when auto
+    fell back — so ``pallas_speedup`` (the compact line's ``pallas_x``)
+    is always auto-relative.  The contract asserted here: when the
+    dispatcher chose Pallas, pallas_x >= 1.0 MUST hold; a violation is
+    recorded as ``pallas_regression`` (the heuristic claimed a win the
+    hardware disproved) so the next bench round fails loudly.  A
+    fallback choice is legitimate by construction and pallas_x < 1.0
+    there just documents why.  Pick equality between the two backends is
+    reported too (MXU accumulation order differs; an argmax tie could
+    flip a pick — interpret-mode tests cannot see this).  TPU only;
+    failures are recorded, never fatal — the production number is
+    already with the parent."""
     import numpy as np
 
     import jax
@@ -531,8 +557,11 @@ def run_kcenter_pallas_ab(budget: int, xla_result: dict,
     labeled = np.zeros(pool_n, dtype=bool)
     labeled[host_rng.choice(pool_n, min(1000, pool_n // 8),
                             replace=False)] = True
-    result = dict(xla_result)
-    os.environ["AL_TPU_KCENTER_PALLAS"] = "1"
+    result = dict(auto_result)
+    auto_backend = str(auto_result.get("backend") or "")
+    auto_was_pallas = auto_backend.startswith("pallas")
+    # Measure the opposite backend from the dispatcher's auto pick.
+    os.environ["AL_TPU_KCENTER_PALLAS"] = "0" if auto_was_pallas else "1"
     try:
         # Inside the try: if the kernel MODULE itself fails to import,
         # that is a pallas_error record, not a child crash.
@@ -545,21 +574,36 @@ def run_kcenter_pallas_ab(budget: int, xla_result: dict,
                                rng=np.random.default_rng(2))
         dt = time.perf_counter() - t0
         if kp.LAST_FALLBACK_ERROR is not None:
-            # The XLA fallback answered: there IS no Pallas measurement,
-            # and recording one would fake a working kernel.
+            # The XLA fallback answered a forced-Pallas run: there IS no
+            # Pallas measurement, and recording one would fake a working
+            # kernel.
             raise RuntimeError(
                 f"kernel fell back to XLA: {kp.LAST_FALLBACK_ERROR}")
         assert len(set(picks.tolist())) == budget
-        result["pallas_ips"] = round(budget / dt, 1)
-        result["pallas_select_sec"] = round(dt, 2)
-        result["pallas_speedup"] = round(
-            result["pallas_ips"] / max(result["ips"], 1e-9), 2)
-        result["pallas_picks_match"] = bool(np.array_equal(picks, xla_picks))
-        log(f"[kcenter_select] pallas: {budget / dt:,.0f} picks/s "
-            f"({result['pallas_speedup']}x the XLA scan), picks_match="
-            f"{result['pallas_picks_match']}")
+        other_ips = budget / dt
+        if auto_was_pallas:
+            pallas_ips, xla_ips = float(result["ips"]), other_ips
+        else:
+            pallas_ips, xla_ips = other_ips, float(result["ips"])
+        result["pallas_ips"] = round(pallas_ips, 1)
+        result["xla_ips"] = round(xla_ips, 1)
+        result["pallas_select_sec"] = round(
+            budget / max(pallas_ips, 1e-9), 2)
+        result["pallas_speedup"] = round(pallas_ips / max(xla_ips, 1e-9), 2)
+        result["pallas_picks_match"] = bool(np.array_equal(picks,
+                                                           auto_picks))
+        if auto_was_pallas and result["pallas_speedup"] < 1.0:
+            # The dispatcher chose the kernel and lost the A/B: the
+            # heuristic must be tightened until it falls back here.
+            result["pallas_regression"] = True
+            log(f"[kcenter_select] REGRESSION: dispatcher chose pallas at "
+                f"{result['pallas_speedup']}x < 1.0 — the heuristic must "
+                "fall back for this shape")
+        log(f"[kcenter_select] pallas {pallas_ips:,.0f} vs xla "
+            f"{xla_ips:,.0f} picks/s ({result['pallas_speedup']}x, auto="
+            f"{auto_backend}), picks_match={result['pallas_picks_match']}")
     except Exception as e:
-        log(f"[kcenter_select] pallas path failed: {e!r}")
+        log(f"[kcenter_select] pallas A/B failed: {e!r}")
         result["pallas_error"] = repr(e)[:200]
     finally:
         os.environ.pop("AL_TPU_KCENTER_PALLAS", None)
@@ -656,8 +700,19 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         log_dir=tmp, ckpt_path=tmp, exp_hash="bench")
     device_kind = jax.devices()[0].device_kind
     n_chips = len(jax.devices())
+    # The production driver enables the persistent XLA compilation cache
+    # (experiment/driver.py:enable_compilation_cache): whether its
+    # default dir already holds entries decides if this run's "cold"
+    # round 0 pays real compiles or warm disk hits — recorded so the
+    # cold-warm compile-tax gap is attributable across bench rounds.
+    xla_cache_dir = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                     or os.path.join(os.path.expanduser("~"), ".cache",
+                                     "al_tpu_xla_cache"))
+    cache_prewarmed = bool(os.path.isdir(xla_cache_dir)
+                           and os.listdir(xla_cache_dir))
     log(f"[al_round_{config}] {model_name} x{n_chips} {device_kind}, "
-        f"budget {budget}, {epochs} epochs, 2 rounds")
+        f"budget {budget}, {epochs} epochs, 2 rounds "
+        f"(compile cache {'warm' if cache_prewarmed else 'cold'})")
     t0 = time.perf_counter()
     try:
         run_experiment(cfg, sink=sink, data=data, train_cfg=train_cfg)
@@ -699,6 +754,11 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         "pool_n": pool_n,
         "round_sec_warm": round(warm, 2),
         "round_sec_cold": round(cold, 2),
+        # The per-run compile tax: everything round 0 pays that round 1
+        # does not (XLA compiles dominate it).  The persistent compile
+        # cache + shape bucketing exist to shrink this gap.
+        "compile_tax_sec": round(cold - warm, 2),
+        "compile_cache_prewarmed": cache_prewarmed,
         "total_sec": round(total_sec, 1),
         "phases_sec": rounds,
         "test_accuracy_rd1": test_acc,
@@ -875,8 +935,9 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
             yield extra
         return
     if phase == "kcenter_select_130k":
-        # Paper scale; the Pallas A/B question is answered at 50k, so
-        # only the XLA scan runs here.
+        # Paper scale, production path (batched greedy + auto dispatch —
+        # the backend chosen rides in "backend"); the forced-backend A/B
+        # question is answered at 50k, so no second run here.
         result, _ = run_kcenter_phase(iters, pool_n=130000)
         result["phase"] = phase
         yield result
@@ -1031,11 +1092,19 @@ def run_phase_with_retries(name: str, iters: int, per_chip: int,
     with platform "cpu" by the child itself.
     Returns (result dict | None, failure string | None)."""
     failure = None
+    # A partial snapshot from a child that OOM-crashed after printing a
+    # completed measurement: kept as a fallback, but the halved-batch
+    # retry still runs — the retry may recover the measurements the crash
+    # cut short (warm/resident passes), and only if it also fails does
+    # the snapshot become the answer.
+    stashed = None
     attempts = max_attempts + 1 if name == "imagenet_datapath" else max_attempts
     for attempt in range(attempts):
         cpu_fallback = name == "imagenet_datapath" and attempt == attempts - 1
         remaining = deadline - time.monotonic()
         if remaining <= 30:
+            if stashed is not None:
+                return stashed, None
             return None, failure or "wall-clock budget exhausted"
         # Reserve ~90s of budget past any single attempt: a hung child
         # granted the full remainder would starve the cached-evidence
@@ -1093,21 +1162,36 @@ def run_phase_with_retries(name: str, iters: int, per_chip: int,
             continue
         # A child that printed a complete measurement and THEN died (e.g.
         # in a later optional pass) still produced evidence — same
-        # discipline as the timeout path above.
+        # discipline as the timeout path above.  Exception: an OOM death
+        # (RESOURCE_EXHAUSTED) is recoverable by the batch-halving retry,
+        # which may capture the measurements the crash cut short — stash
+        # the snapshot and keep climbing the ladder instead of returning
+        # a partial result as success.
+        tail = (proc.stderr or "")[-2000:]
         result = _parse_child_json(proc.stdout)
         if result is not None:
-            log(f"[parent] {name}: child exited {proc.returncode} after "
-                "a completed measurement; keeping it")
-            return result, None
-        tail = (proc.stderr or "")[-2000:]
-        failure = f"exit {proc.returncode}: {tail.strip().splitlines()[-1] if tail.strip() else 'no stderr'}"
-        log(f"[parent] {name}: {failure}")
+            if "RESOURCE_EXHAUSTED" in tail and attempt < attempts - 1:
+                log(f"[parent] {name}: child OOMed (exit "
+                    f"{proc.returncode}) after a completed measurement; "
+                    "stashing it and retrying at half batch")
+                stashed = result
+            else:
+                log(f"[parent] {name}: child exited {proc.returncode} "
+                    "after a completed measurement; keeping it")
+                return result, None
+        else:
+            failure = f"exit {proc.returncode}: {tail.strip().splitlines()[-1] if tail.strip() else 'no stderr'}"
+            log(f"[parent] {name}: {failure}")
         if "RESOURCE_EXHAUSTED" in tail:
             per_chip = max(16, per_chip // 2)
         elif "UNAVAILABLE" in tail or "DEADLINE_EXCEEDED" in tail \
                 or "failed to initialize" in tail.lower():
             time.sleep(15)  # transient backend trouble; let it settle
         iters = _halve_iters(iters)
+    if stashed is not None:
+        log(f"[parent] {name}: retries failed; returning the stashed "
+            "pre-OOM snapshot")
+        return stashed, None
     return None, failure
 
 
@@ -1312,8 +1396,11 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
         for src, dst in (("ips_warm", "warm_ips"),
                          ("round_sec_warm", "warm_s"),
                          ("round_sec_cold", "cold_s"),
+                         ("compile_tax_sec", "tax_s"),
                          ("test_accuracy_rd1", "acc"),
-                         ("pallas_speedup", "pallas_x")):
+                         ("pallas_speedup", "pallas_x"),
+                         ("pallas_regression", "pallas_regression"),
+                         ("backend", "be")):
             if e.get(src) is not None:
                 c[dst] = e[src]
         phases[name] = c

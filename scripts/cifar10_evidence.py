@@ -161,8 +161,11 @@ def run_strategy(name: str, data, model_name: str, args, workdir: str,
                 name="cosine", t_max=args.epochs,
                 # Clamped so a smoke-length run still reaches peak lr and
                 # executes a cosine phase (3 warmup epochs in a 2-epoch
-                # run would never leave the ramp).
-                warmup_epochs=min(3, max(1, args.epochs // 2))))
+                # run would never leave the ramp).  No max(1, ...) floor:
+                # a 1-epoch smoke run must fall back to plain cosine
+                # (warmup 0) — warmup_epochs == t_max == 1 makes
+                # _cosine_lr raise at trainer build.
+                warmup_epochs=min(3, args.epochs // 2)))
     if model_name == "probe":
         # Calibrated for the pure-linear probe (matches the sklearn
         # logistic-regression settings the facsimile difficulty was

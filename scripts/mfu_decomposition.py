@@ -195,13 +195,20 @@ def main():
     GF = {"train_full": 23.91, "train_frozen_bn": 23.91,
           "fwd_only_train_bn": 7.97, "fwd_only_frozen_bn": 7.97,
           "score_fwd_eval_bn": 7.97}
-    peak = 197.0 if "v5" in out["device_kind"].lower() else None
+    # Explicit device-kind match: a bare "v5" substring also matches v5p
+    # (bf16 peak ~459 TFLOP/s), which would inflate reported MFU ~2.3x.
+    # Unknown kinds leave mfu unset rather than guess a peak.
+    kind = out["device_kind"].lower()
+    peak = 197.0 if ("v5e" in kind or "v5 lite" in kind) else None
     for name, entry in out["timings"].items():
         tf = entry["ips_per_chip"] * GF[name] / 1000.0
         entry["tflops_per_sec_per_chip"] = round(tf, 1)
         if peak:
             entry["mfu"] = round(tf / peak, 3)
     out["gf_per_image_source"] = "bench.py device-cost-analysis (r5)"
+    out["gf_note"] = ("train_frozen_bn reuses the full-BN 23.91 GF/img "
+                      "(no separate cost-analysis capture); its achieved "
+                      "TFLOP/s is therefore a slight overcount")
     with open(args.out, "w") as fh:
         json.dump(out, fh, indent=1)
     print(json.dumps({k: v for k, v in out["timings"].items()}))

@@ -1,0 +1,142 @@
+"""Recompile-count regression tests for the shape-bucketing scheme.
+
+The AL round loop's shapes drift every round — the labeled set grows, a
+subset-capped selection pool shrinks — and every drifted shape is a
+fresh XLA compile unless it is bucketed away (pool.bucket_size).  These
+tests pin the contract: two consecutive rounds whose sizes stay inside
+one bucket trigger ZERO new jit compilations, measured directly off the
+jitted functions' compilation caches.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from active_learning_tpu.pool import bucket_size
+
+
+def _cache_size(jitted) -> int:
+    return jitted._cache_size()
+
+
+class TestBucketSize:
+    def test_values(self):
+        assert bucket_size(1, floor=16) == 16
+        assert bucket_size(16, floor=16) == 16
+        assert bucket_size(17, floor=16) == 32
+        assert bucket_size(300) == 512
+        assert bucket_size(512) == 512
+        # 1/8-octave granularity, NOT pure pow2: past a boundary the
+        # bucket grows by the granule (256 here), not by doubling —
+        # padded rows/steps still execute, so waste must stay bounded.
+        assert bucket_size(513) == 768
+        assert bucket_size(130000) == 131072
+
+    def test_monotone_and_bounded_waste(self):
+        for n in (1, 7, 255, 256, 1000, 4097, 70000, 130000):
+            b = bucket_size(n)
+            assert b >= n and b >= 256
+            if n > 256:
+                # Recurring-compute waste cap: granule is 1/8 of the
+                # enclosing pow2, so padding < ~14% of n.
+                assert b - n < max(256, b // 4)
+                assert b < 2 * max(n, 256)
+
+
+class TestKCenterCompileReuse:
+    def _run(self, n, n_labeled, budget, seed=0, batch_q=8):
+        from active_learning_tpu.strategies.kcenter import kcenter_greedy
+        rng = np.random.default_rng(seed)
+        emb = rng.normal(size=(n, 24)).astype(np.float32)
+        labeled = np.zeros(n, dtype=bool)
+        labeled[rng.choice(n, n_labeled, replace=False)] = True
+        picks = kcenter_greedy((emb,), labeled, budget,
+                               rng=np.random.default_rng(1),
+                               batch_q=batch_q)
+        assert len(picks) == budget
+
+    def test_grown_pool_same_bucket_zero_new_compiles(self):
+        """Round N -> N+1 with a drifted pool size and a grown labeled
+        set, both inside one power-of-two bucket: the selection scan AND
+        the chunked initial-min pass reuse their executables."""
+        from active_learning_tpu.strategies import kcenter as kc
+
+        self._run(300, 20, 10)  # pool bucket 512, warm
+        scan = _cache_size(kc._kcenter_scan_batched)
+        chunk = _cache_size(kc._min_dist_chunk)
+        self._run(340, 50, 10, seed=5)  # grown; same 512 bucket
+        assert _cache_size(kc._kcenter_scan_batched) == scan
+        assert _cache_size(kc._min_dist_chunk) == chunk
+
+    def test_bucket_boundary_recompiles_once(self):
+        from active_learning_tpu.strategies import kcenter as kc
+
+        self._run(300, 20, 10)
+        scan = _cache_size(kc._kcenter_scan_batched)
+        self._run(600, 20, 10, seed=6)  # crosses into the 1024 bucket
+        assert _cache_size(kc._kcenter_scan_batched) == scan + 1
+
+
+class TestEpochScanCompileReuse:
+    def test_two_rounds_grown_labeled_zero_new_compiles(self):
+        """The device-resident epoch scan across two AL 'rounds' whose
+        labeled sets differ but land in the same step bucket compiles
+        exactly once."""
+        from helpers import TinyClassifier, tiny_train_config
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.train.trainer import Trainer
+        import dataclasses
+
+        train_set, _, al_set = get_data_synthetic(n_train=96, n_test=16)
+        cfg = dataclasses.replace(tiny_train_config(batch_size=16),
+                                  device_resident=True)
+        mesh = mesh_lib.make_mesh()
+        trainer = Trainer(TinyClassifier(), cfg, mesh, 4)
+
+        def fit_round(n_labeled, seed):
+            # Fresh state per round, as the driver's init_network_weights
+            # does (the fitted state's buffers are donated into the scan).
+            state = trainer.init_state(jax.random.PRNGKey(seed),
+                                       train_set.gather(np.arange(2)))
+            rng = np.random.default_rng(seed)
+            labeled = np.sort(rng.choice(96, n_labeled, replace=False))
+            return trainer.fit(state, train_set, labeled, al_set,
+                               np.arange(90, 96), n_epoch=2, es_patience=0,
+                               rng=rng, round_idx=0)
+
+        fit_round(24, 0)  # 2 steps of 16 -> the 16-step floor bucket
+        assert trainer._epoch_scan is not None
+        scans = _cache_size(trainer._epoch_scan)
+        steps = _cache_size(trainer._train_step)
+        fit_round(60, 1)  # grown labeled set, 4 steps -> same bucket
+        assert _cache_size(trainer._epoch_scan) == scans
+        assert _cache_size(trainer._train_step) == steps
+
+    def test_bucket_steps_rule(self):
+        from active_learning_tpu.train.trainer import Trainer
+
+        assert Trainer.bucket_steps(1) == Trainer.STEP_BUCKET
+        assert Trainer.bucket_steps(16) == 16
+        assert Trainer.bucket_steps(17) == 32
+        assert Trainer.bucket_steps(33) == 48
+        assert Trainer.bucket_steps(64) == 64
+        # The case the pure-pow2 rule got wrong: 157 steps must not pay
+        # 99 masked-but-executed train steps per epoch (256), only 3.
+        assert Trainer.bucket_steps(157) == 160
+
+
+class TestCompilationCacheConfig:
+    def test_driver_enables_persistent_cache(self, tmp_path, monkeypatch):
+        from active_learning_tpu.experiment import driver
+
+        target = str(tmp_path / "xla_cache")
+        got = driver.enable_compilation_cache(target)
+        assert got == target
+        assert jax.config.jax_compilation_cache_dir == target
+
+    def test_empty_string_disables(self):
+        from active_learning_tpu.experiment import driver
+
+        assert driver.enable_compilation_cache("") is None

@@ -433,6 +433,59 @@ class TestBenchHarness:
         assert result is None and failure.startswith("exit 1")
         assert len(calls) == 3  # both attempts of the ladder actually ran
 
+    def test_oom_crash_stashes_snapshot_and_still_retries(self,
+                                                          monkeypatch):
+        """A child that OOMed (RESOURCE_EXHAUSTED) after printing a
+        partial measurement must NOT end the ladder: the halved-batch
+        retry can recover the measurements the crash cut short.  The
+        snapshot is returned only when the retry also fails (ADVICE r5
+        #3)."""
+        import types
+
+        bench = self._bench()
+        partial = '{"phase": "p", "ips": 5.0, "ips_per_chip": 5.0}\n'
+        full = ('{"phase": "p", "ips": 4.0, "ips_per_chip": 4.0, '
+                '"ips_warm": 9.0}\n')
+
+        calls = []
+
+        def fake_run_retry_wins(cmd, **kwargs):
+            calls.append(cmd)
+            if len(calls) == 1:
+                return types.SimpleNamespace(
+                    returncode=1, stdout=partial,
+                    stderr="RESOURCE_EXHAUSTED: out of memory")
+            return types.SimpleNamespace(returncode=0, stdout=full,
+                                         stderr="")
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run_retry_wins)
+        result, failure = bench.run_phase_with_retries(
+            "p", iters=30, per_chip=64, timeout=30,
+            deadline=bench.time.monotonic() + 300, max_attempts=2)
+        assert failure is None and result["ips_warm"] == 9.0
+        assert len(calls) == 2  # the retry actually ran
+        # ... at half the per-chip batch.
+        assert "32" in calls[1][calls[1].index("--per-chip-batch") + 1]
+
+        calls.clear()
+
+        def fake_run_retry_fails(cmd, **kwargs):
+            calls.append(cmd)
+            if len(calls) == 1:
+                return types.SimpleNamespace(
+                    returncode=1, stdout=partial,
+                    stderr="RESOURCE_EXHAUSTED: out of memory")
+            return types.SimpleNamespace(returncode=1, stdout="",
+                                         stderr="hard crash")
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run_retry_fails)
+        result, failure = bench.run_phase_with_retries(
+            "p", iters=30, per_chip=64, timeout=30,
+            deadline=bench.time.monotonic() + 300, max_attempts=2)
+        assert failure is None  # the stashed snapshot is the answer
+        assert result == {"phase": "p", "ips": 5.0, "ips_per_chip": 5.0}
+        assert len(calls) == 2
+
     @pytest.mark.slow
     def test_al_round_phase_smoke(self, monkeypatch):
         """run_al_round_phase end to end at smoke scale: the phase that

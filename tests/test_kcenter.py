@@ -89,6 +89,60 @@ class TestKCenterGreedy:
                                    rtol=1e-4, atol=1e-4)
 
 
+class TestBatchedGreedy:
+    """Batched farthest-first (q picks per pool pass with the exact
+    in-batch re-check) must be pick-for-pick identical to q=1 greedy —
+    the correctness claim that makes cutting scan steps ~q x free."""
+
+    @pytest.mark.parametrize("q", [2, 3, 8])
+    def test_batched_matches_q1_and_oracle(self, q):
+        rng = np.random.default_rng(11)
+        emb = rng.normal(size=(70, 6)).astype(np.float32)
+        labeled = np.zeros(70, dtype=bool)
+        labeled[rng.choice(70, 9, replace=False)] = True
+        budget = 13  # not a multiple of any q above
+        want = oracle_kcenter(emb, labeled, budget)
+        q1 = kcenter_greedy((emb,), labeled, budget, randomize=False,
+                            rng=np.random.default_rng(1), batch_q=1)
+        np.testing.assert_array_equal(q1, want)
+        got = kcenter_greedy((emb,), labeled, budget, randomize=False,
+                             rng=np.random.default_rng(1), batch_q=q)
+        np.testing.assert_array_equal(got, want)
+
+    def test_batched_from_empty_labeled_seed(self):
+        rng = np.random.default_rng(12)
+        emb = rng.normal(size=(40, 4)).astype(np.float32)
+        labeled = np.zeros(40, dtype=bool)
+        want = oracle_kcenter(emb, labeled, 9)
+        got = kcenter_greedy((emb,), labeled, 9, randomize=False,
+                             rng=np.random.default_rng(2), batch_q=4)
+        np.testing.assert_array_equal(got, want)
+
+    def test_batched_two_factor(self):
+        rng = np.random.default_rng(13)
+        a = rng.normal(size=(30, 5)).astype(np.float32)
+        e = rng.normal(size=(30, 7)).astype(np.float32)
+        g = np.einsum("nc,nd->ncd", a, e).reshape(30, -1)
+        labeled = np.zeros(30, dtype=bool)
+        labeled[[2, 17]] = True
+        got = kcenter_greedy((a, e), labeled, 7, randomize=False,
+                             rng=np.random.default_rng(3), batch_q=4)
+        np.testing.assert_array_equal(got, oracle_kcenter(g, labeled, 7))
+
+    def test_budget_exhausts_pool(self):
+        # budget == every unlabeled point: the re-check's stop-early and
+        # the while loop's budget clamp must still deliver them all.
+        rng = np.random.default_rng(14)
+        emb = rng.normal(size=(20, 3)).astype(np.float32)
+        labeled = np.zeros(20, dtype=bool)
+        labeled[:5] = True
+        got = kcenter_greedy((emb,), labeled, 15, randomize=False,
+                             rng=np.random.default_rng(4), batch_q=8)
+        assert np.unique(got).size == 15
+        assert not labeled[got].any()
+        np.testing.assert_array_equal(got, oracle_kcenter(emb, labeled, 15))
+
+
 class TestFactorizedDistances:
     def test_two_factor_dots_equal_outer_product_dots(self):
         rng = np.random.default_rng(8)

@@ -1,5 +1,6 @@
-"""The Pallas k-center distance-update kernel vs the plain jnp expression
-(interpret mode — same semantics as the compiled TPU kernel)."""
+"""The fused Pallas k-center kernel vs the plain jnp expressions
+(interpret mode — same semantics as the compiled TPU kernel), plus the
+backend dispatcher's contract."""
 
 import numpy as np
 import pytest
@@ -7,23 +8,54 @@ import pytest
 import jax.numpy as jnp
 
 from active_learning_tpu.ops import kcenter_pallas as kp
+from active_learning_tpu.strategies import kcenter as kc
+
+
+def _setup(n, d, seed=0, n_inf_min=False):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    xt = kp.pad_to_tiles(jnp.asarray(x))
+    n_pad = xt.shape[1]
+    sqn = np.zeros((1, n_pad), np.float32)
+    sqn[0, :n] = (x * x).sum(axis=1)
+    min_dist = np.zeros((1, n_pad), np.float32)
+    min_dist[0, :n] = (np.full(n, np.inf, np.float32) if n_inf_min
+                       else rng.uniform(0.1, 50.0, size=n).astype(np.float32))
+    sel = np.zeros((1, n_pad), np.float32)
+    sel[0, :n] = (rng.uniform(size=n) > 0.1).astype(np.float32)
+    return x, xt, sqn, min_dist, sel
 
 
 @pytest.mark.parametrize("n,d", [(512, 512), (1024, 1024), (1536, 512)])
-def test_matches_jnp_update(n, d):
-    rng = np.random.default_rng(0)
-    x = rng.normal(size=(n, d)).astype(np.float32)
-    xt = kp.pad_to_tiles(jnp.asarray(x))
-    sqn = (x * x).sum(axis=1)[None, :]
-    min_dist = rng.uniform(0.1, 50.0, size=(1, n)).astype(np.float32)
-    for idx in (0, 7, n - 1):
-        want = np.minimum(
-            min_dist[0], sqn[0] + sqn[0, idx] - 2.0 * (x @ x[idx]))
-        got = kp.min_dist_update(xt, jnp.asarray(sqn),
-                                 jnp.asarray(min_dist),
-                                 jnp.int32(idx), interpret=True)
-        np.testing.assert_allclose(np.asarray(got)[0], want,
+def test_fused_update_matches_jnp(n, d):
+    x, xt, sqn, min_dist, sel = _setup(n, d)
+    for centers in ([0] * kp.CENTER_TILE,
+                    [7, n - 1, 3, 7, 7, 7, 7, 7],
+                    list(range(kp.CENTER_TILE))):
+        got, _, _ = kp.fused_update_argmax(
+            xt, jnp.asarray(sqn), jnp.asarray(min_dist), jnp.asarray(sel),
+            jnp.asarray(centers, jnp.int32), interpret=True)
+        want = min_dist[0, :n].copy()
+        for c in set(centers):
+            want = np.minimum(want,
+                              sqn[0, :n] + sqn[0, c] - 2.0 * (x @ x[c]))
+        np.testing.assert_allclose(np.asarray(got)[0, :n], want,
                                    rtol=1e-5, atol=1e-3)
+
+
+def test_fused_argmax_matches_masked_argmax():
+    n, d = 1536, 512
+    x, xt, sqn, min_dist, sel = _setup(n, d, seed=3)
+    centers = jnp.asarray([11, 400, 900, 11, 11, 11, 11, 11], jnp.int32)
+    new_min, bmax, barg = kp.fused_update_argmax(
+        xt, jnp.asarray(sqn), jnp.asarray(min_dist), jnp.asarray(sel),
+        centers, interpret=True)
+    # The scan's global reduction: first block holding the max, lowest
+    # lane within it — must equal jnp.argmax over the masked row.
+    pick = int(np.asarray(barg)[0, np.argmax(np.asarray(bmax)[0])])
+    masked = np.where(np.asarray(sel)[0] > 0, np.asarray(new_min)[0],
+                      -np.inf)
+    assert pick == int(np.argmax(masked))
 
 
 def test_padded_tiles_roundtrip():
@@ -32,23 +64,35 @@ def test_padded_tiles_roundtrip():
     x = rng.normal(size=(n, d)).astype(np.float32)
     xt = kp.pad_to_tiles(jnp.asarray(x))
     assert xt.shape == (512, 1024)
-    sqn_real = (x * x).sum(axis=1)
-    sqn = np.zeros((1, xt.shape[1]), np.float32)
-    sqn[0, :n] = sqn_real
-    min_dist = np.full((1, xt.shape[1]), np.inf, np.float32)
+    sqn = np.zeros((1, 1024), np.float32)
+    sqn[0, :n] = (x * x).sum(axis=1)
+    min_dist = np.full((1, 1024), np.inf, np.float32)
     min_dist[0, :n] = rng.uniform(1.0, 9.0, size=n).astype(np.float32)
+    sel = np.zeros((1, 1024), np.float32)
+    sel[0, :n] = 1.0
     idx = 3
-    got = kp.min_dist_update(xt, jnp.asarray(sqn), jnp.asarray(min_dist),
-                             jnp.int32(idx), interpret=True)
+    got, _, _ = kp.fused_update_argmax(
+        xt, jnp.asarray(sqn), jnp.asarray(min_dist), jnp.asarray(sel),
+        jnp.full((kp.CENTER_TILE,), idx, jnp.int32), interpret=True)
     want = np.minimum(min_dist[0, :n],
-                      sqn_real + sqn_real[idx] - 2.0 * (x @ x[idx]))
+                      sqn[0, :n] + sqn[0, idx] - 2.0 * (x @ x[idx]))
     np.testing.assert_allclose(np.asarray(got)[0, :n], want,
                                rtol=1e-5, atol=1e-3)
 
 
-def test_kcenter_greedy_pallas_matches_xla(monkeypatch):
-    """The full greedy selection with the Pallas update (interpret mode)
-    picks the same points in the same order as the XLA scan."""
+def test_pad_centers():
+    idxs = jnp.asarray([5, 9, 2], jnp.int32)
+    padded = kp.pad_centers(idxs)
+    assert padded.shape[0] % kp.CENTER_TILE == 0
+    np.testing.assert_array_equal(np.asarray(padded)[:3], [5, 9, 2])
+    assert set(np.asarray(padded)[3:].tolist()) == {5}
+
+
+@pytest.mark.parametrize("batch_q", [1, 8])
+def test_kcenter_greedy_pallas_matches_xla(monkeypatch, batch_q):
+    """The full greedy selection with the fused Pallas kernel (interpret
+    mode) picks the same points in the same order as the XLA scan — for
+    both the q=1 fused update+argmax scan and the batched path."""
     from active_learning_tpu.strategies.kcenter import kcenter_greedy
 
     rng = np.random.default_rng(7)
@@ -57,9 +101,30 @@ def test_kcenter_greedy_pallas_matches_xla(monkeypatch):
     labeled[rng.choice(600, 40, replace=False)] = True
 
     monkeypatch.delenv("AL_TPU_KCENTER_PALLAS", raising=False)
-    want = kcenter_greedy([x], labeled, 25,
-                          rng=np.random.default_rng(0))
+    want = kcenter_greedy([x], labeled, 25, rng=np.random.default_rng(0),
+                          batch_q=batch_q)
     monkeypatch.setenv("AL_TPU_KCENTER_PALLAS", "interpret")
-    got = kcenter_greedy([x], labeled, 25,
-                         rng=np.random.default_rng(0))
+    got = kcenter_greedy([x], labeled, 25, rng=np.random.default_rng(0),
+                         batch_q=batch_q)
+    assert kp.LAST_BACKEND == "pallas-interpret"
     np.testing.assert_array_equal(got, want)
+
+
+def test_dispatcher_contract(monkeypatch):
+    """Auto dispatch must fall back to XLA everywhere the kernel has no
+    measured win: off-TPU, randomized, multi-factor, small pools, q < a
+    center tile.  Explicit modes override."""
+    monkeypatch.delenv("AL_TPU_KCENTER_PALLAS", raising=False)
+    # Off-TPU (this CI runs on CPU): always XLA, even at winning shapes.
+    assert kc._select_backend(65536, 2048, 1, False, 8) == "xla"
+    assert kc._select_backend(65536, 2048, 2, False, 8) == "xla"
+    assert kc._select_backend(65536, 2048, 1, True, 8) == "xla"
+    monkeypatch.setenv("AL_TPU_KCENTER_PALLAS", "1")
+    assert kc._select_backend(65536, 2048, 1, False, 8) == "pallas"
+    # Multi-factor / randomized never take the kernel, even forced.
+    assert kc._select_backend(65536, 2048, 2, False, 8) == "xla"
+    assert kc._select_backend(65536, 2048, 1, True, 8) == "xla"
+    monkeypatch.setenv("AL_TPU_KCENTER_PALLAS", "0")
+    assert kc._select_backend(65536, 2048, 1, False, 8) == "xla"
+    monkeypatch.setenv("AL_TPU_KCENTER_PALLAS", "interpret")
+    assert kc._select_backend(256, 96, 1, False, 8) == "pallas-interpret"
