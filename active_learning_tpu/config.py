@@ -11,8 +11,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
 
-# Default budget for device-resident acquisition-scoring pools (see
-# TrainConfig.resident_scoring_bytes and strategies/scoring.py).
+# Fallback budget for device-resident acquisition-scoring pools when the
+# backend exposes no HBM statistics to auto-size from (CPU, some tunneled
+# runtimes) — see TrainConfig.resident_scoring_bytes and
+# parallel/resident.resolve_budget.
 RESIDENT_SCORING_BYTES_DEFAULT = 2 ** 31
 
 
@@ -105,6 +107,21 @@ class TrainConfig:
     # TPU the MXU's native precision is bf16 and fp32 would halve
     # throughput for no accuracy win at these model scales.
     dtype: str = "auto"
+    # BatchNorm batch-statistics read precision.  "auto" follows the
+    # compute dtype: bf16 models compute batch mean/var by reducing the
+    # bf16 activations directly with float32 ACCUMULATION
+    # (models/resnet.FusedBatchNorm) instead of flax's
+    # materialize-as-float32-then-reduce — the stats pass was measured at
+    # -23% of ResNet-50 forward throughput (mfu_decomposition.json).
+    # "float32" forces the flax path; running statistics are float32
+    # either way.
+    bn_stats_dtype: str = "auto"
+    # ResNet stem layout: "default" keeps the reference 7x7/s2 conv;
+    # "s2d" folds it into an exact 4x4/s1 conv over space-to-depth
+    # (112x112x12) input on the 224px path — same arithmetic, 4x the
+    # contraction channels for the MXU (models/resnet.py; CIFAR-stem
+    # models ignore this).
+    stem: str = "default"
     loader_tr: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     loader_te: LoaderConfig = dataclasses.field(
         default_factory=lambda: LoaderConfig(batch_size=100))
@@ -152,12 +169,15 @@ class TrainConfig:
     # Keep in-memory datasets resident on device (replicated) for the
     # whole experiment — ONE shared upload serves every round's
     # acquisition scoring AND the per-epoch validation/test evaluation
-    # (parallel/resident.py).  The budget applies per underlying image
-    # array that fits under it (the AL pool and the test set are separate
-    # arrays, so each may pin up to this size).  0 disables both resident
-    # paths; lower it on small-HBM chips where pinned pools could crowd
-    # out training.
-    resident_scoring_bytes: int = RESIDENT_SCORING_BYTES_DEFAULT
+    # (parallel/resident.py).  None = AUTO (the default): the budget is
+    # sized from live HBM headroom at round start (bytes_limit −
+    # bytes_in_use − a training-activation reserve), so any pool that
+    # fits the chip pins by default; backends without memory statistics
+    # fall back to a conservative 2 GB.  An explicit integer pins the
+    # budget (0 disables both resident paths).  The budget applies per
+    # underlying image array that fits under it (the AL pool and the
+    # test set are separate arrays, so each may pin up to this size).
+    resident_scoring_bytes: Optional[int] = None
 
     @property
     def has_pretrained(self) -> bool:
@@ -242,13 +262,21 @@ class ExperimentConfig:
     # TrainConfig.dtype ("auto" = bf16 on TPU / f32 elsewhere).
     dtype: Optional[str] = None
 
+    # BN batch-statistics precision override: None defers to the arg
+    # pool's TrainConfig.bn_stats_dtype ("auto" = fused bf16 stats on
+    # bf16 models).
+    bn_stats_dtype: Optional[str] = None
+
+    # ResNet stem override ("default"/"s2d"): None defers to the arg
+    # pool's TrainConfig.stem.  See TrainConfig.stem.
+    stem: Optional[str] = None
+
     # Device-resident pool budget override (bytes): None defers to the
-    # arg pool's TrainConfig.resident_scoring_bytes (conservative 2 GB).
-    # On 16 GB-HBM chips, sizing this over the decoded al-pool (e.g.
-    # 10000000000 = 10 GB for a 50k ImageNet-shape pool at 7.5 GB,
-    # --resident_scoring_bytes takes a plain integer) pins the pool in HBM
-    # after round 0's decode and turns every later query/eval pass into
-    # on-device gathers — no per-batch host->device image traffic.
+    # arg pool's TrainConfig.resident_scoring_bytes, whose default is
+    # AUTO — sized from live HBM headroom at round start, so pools that
+    # fit the chip pin in HBM by default and every later query/eval pass
+    # is on-device gathers (no per-batch host->device image traffic).
+    # Pass an explicit integer to pin the budget, 0 to disable residency.
     resident_scoring_bytes: Optional[int] = None
 
     # Coreset / BADGE partitioning (parser.py:74-79)

@@ -19,10 +19,31 @@ from .core import Normalization, ViewSpec
 
 
 def normalize(images_u8: jnp.ndarray, norm: Normalization) -> jnp.ndarray:
-    """uint8 [B,H,W,C] -> float32 normalized (ToTensor + Normalize)."""
+    """uint8 [B,H,W,C] -> float32 normalized (ToTensor + Normalize).
+
+    Space-to-depth batches (data/pipeline.space_to_depth: channel index
+    (di*2 + dj)*C + c) are per-PIXEL the same affine transform, so the
+    mean/std vectors just tile 4x along the blocked channel axis."""
     mean = jnp.asarray(norm.mean, dtype=jnp.float32) * 255.0
     std = jnp.asarray(norm.std, dtype=jnp.float32) * 255.0
+    blocks = images_u8.shape[-1] // mean.shape[0]
+    if blocks > 1:
+        mean = jnp.tile(mean, blocks)
+        std = jnp.tile(std, blocks)
     return (images_u8.astype(jnp.float32) - mean) / std
+
+
+def s2d_flip(images: jnp.ndarray, flip: jnp.ndarray) -> jnp.ndarray:
+    """Per-sample horizontal flip of a space-to-depth batch [B, H/2, W/2,
+    4C]: mirroring the original W axis reverses the blocked column axis
+    AND swaps the dj∈{0,1} in-block offsets — channel (di, dj, c) maps to
+    (di, 1-dj, c).  Exactly equal to s2d(flip(x)); pinned by
+    tests/test_s2d_stem.py."""
+    c4 = images.shape[-1]
+    c = c4 // 4
+    perm = jnp.arange(c4).reshape(2, 2, c)[:, ::-1, :].reshape(-1)
+    flipped = images[:, :, ::-1, :][..., perm]
+    return jnp.where(flip[:, None, None, None], flipped, images)
 
 
 def random_crop_flip(images: jnp.ndarray, key: jax.Array,
@@ -63,7 +84,17 @@ def apply_view(images_u8: jnp.ndarray, view: ViewSpec,
     transform).
     """
     x = images_u8
+    s2d = len(view.normalization.mean) * 4 == x.shape[-1]
     if view.augment and train:
         assert key is not None, "augmentation requires a PRNG key"
-        x = random_crop_flip(x, key, pad=view.pad)
+        if s2d:
+            # Space-to-depth batches only exist on the 224px path, whose
+            # train view is flip-only (pad=0: the random crop happened at
+            # decode time, data/imagenet.py).
+            assert view.pad == 0, "s2d batches support flip-only views"
+            _, key_flip = jax.random.split(key)
+            x = s2d_flip(x, jax.random.bernoulli(key_flip, 0.5,
+                                                 (x.shape[0],)))
+        else:
+            x = random_crop_flip(x, key, pad=view.pad)
     return normalize(x, view.normalization)

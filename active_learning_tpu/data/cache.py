@@ -254,6 +254,66 @@ class DecodedPoolCache:
         self._valid.flush()
 
 
+def device_prefetch(batches, put, depth: int = 2):
+    """Async double-buffered host->device feed: a background thread pulls
+    host batches from ``batches`` and calls ``put`` (e.g.
+    mesh.shard_batch — jax device transfers are async-dispatch, so the
+    h2d of batch n+1 is in flight while batch n computes), yielding
+    device batches IN ORDER from a queue bounded at ``depth``.
+
+    This is the residency fallback for pools too big for HBM
+    (strategies/scoring.collect_pool): without it the host path serializes
+    gather -> transfer -> dispatch per batch, so query time is the SUM of
+    host and device time; with it the pass is bounded by max(host feed,
+    PCIe, device).  ``depth`` bounds in-flight device batches so the
+    prefetcher can never race a whole pool into HBM.  Errors from the
+    feeder thread re-raise at the consuming ``next()``; an abandoned
+    generator unblocks and joins the thread on close().
+    """
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+    DONE, ERROR = object(), object()
+
+    def feed():
+        try:
+            for batch in batches:
+                item = put(batch)
+                while not stop.is_set():
+                    try:
+                        q.put((None, item), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            q.put((DONE, None))
+        except BaseException as e:  # noqa: BLE001 - re-raised at consumer
+            q.put((ERROR, e))
+
+    t = threading.Thread(target=feed, name="al-device-prefetch",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            tag, item = q.get()
+            if tag is DONE:
+                return
+            if tag is ERROR:
+                raise item
+            yield item
+    finally:
+        stop.set()
+        while True:  # drain so the feeder's put() can't deadlock join
+            try:
+                q.get_nowait()
+            except Exception:
+                break
+        t.join(timeout=5.0)
+
+
 def maybe_wrap_decoded(dataset, cache_dir: Optional[str],
                        max_bytes: int) -> "Dataset":
     """Wrap ``dataset`` in a DecodedPoolCache when it is a disk-backed

@@ -53,9 +53,24 @@ def padded_batch_layout(batch_idxs: np.ndarray, batch_size: int):
     return idxs, mask
 
 
+def space_to_depth(images: np.ndarray, block: int = 2) -> np.ndarray:
+    """Host-side space-to-depth: uint8 [B, H, W, C] -> [B, H/b, W/b,
+    b*b*C], channel index (di*b + dj)*C + c — the SAME layout contract as
+    the device-side models/resnet.space_to_depth and the s2d stem's folded
+    kernel (s2d_stem_kernel).  Byte count is unchanged (the h2d transfer
+    costs the same); doing it here keeps the layout shuffle off the
+    accelerator step for streamed disk datasets."""
+    b, h, w, c = images.shape
+    x = images.reshape(b, h // block, block, w // block, block, c)
+    return np.ascontiguousarray(
+        x.transpose(0, 1, 3, 2, 4, 5)).reshape(
+            b, h // block, w // block, block * block * c)
+
+
 def gather_batch(dataset: Dataset, batch_idxs: np.ndarray,
                  batch_size: int,
-                 local: Optional[slice] = None) -> Dict[str, np.ndarray]:
+                 local: Optional[slice] = None,
+                 s2d: bool = False) -> Dict[str, np.ndarray]:
     """Gather one fixed-shape batch: uint8 images + labels + pool indices +
     validity mask (0.0 on padding rows).
 
@@ -78,6 +93,8 @@ def gather_batch(dataset: Dataset, batch_idxs: np.ndarray,
         images = np.concatenate(
             [images, np.repeat(pad_img, len(idxs) - n_real, axis=0)], axis=0)
     labels = dataset.targets[idxs]
+    if s2d:
+        images = space_to_depth(images)
     return {"image": images, "label": labels.astype(np.int32),
             "index": np.asarray(idxs, dtype=np.int32), "mask": mask}
 
@@ -92,6 +109,7 @@ def iterate_batches(
     prefetch: int = 2,
     num_threads: int = 0,
     local: Optional[slice] = None,
+    s2d: bool = False,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Yield fixed-shape host batches; with ``num_threads > 0``, N worker
     threads gather/decode batches concurrently and results are reassembled
@@ -103,7 +121,7 @@ def iterate_batches(
                                 drop_last=drop_last)
     if num_threads <= 0:
         for b in batches:
-            yield gather_batch(dataset, b, batch_size, local=local)
+            yield gather_batch(dataset, b, batch_size, local=local, s2d=s2d)
         return
 
     from collections import deque
@@ -117,13 +135,14 @@ def iterate_batches(
         max_inflight = num_threads + max(1, prefetch)
         for b in itertools.islice(it, max_inflight):
             pending.append(executor.submit(gather_batch, dataset, b,
-                                           batch_size, local=local))
+                                           batch_size, local=local, s2d=s2d))
         while pending:
             batch = pending.popleft().result()  # ordered; errors propagate
             nxt = next(it, None)
             if nxt is not None:
                 pending.append(executor.submit(gather_batch, dataset, nxt,
-                                               batch_size, local=local))
+                                               batch_size, local=local,
+                                               s2d=s2d))
             yield batch
     finally:
         executor.shutdown(wait=False, cancel_futures=True)

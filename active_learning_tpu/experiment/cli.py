@@ -74,13 +74,25 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", type=str, default=None,
                    choices=["auto", "bfloat16", "float32"],
                    help="model compute precision (params/BN stay float32)")
+    p.add_argument("--bn_stats_dtype", type=str, default=None,
+                   choices=["auto", "bfloat16", "float32"],
+                   help="BN batch-statistics read precision: auto = fused "
+                        "bf16-read/f32-accumulate stats on bf16 models "
+                        "(the flax f32 promotion costs ~23%% of ResNet-50 "
+                        "forward); float32 forces the flax path")
+    p.add_argument("--stem", type=str, default=None,
+                   choices=["default", "s2d"],
+                   help="ResNet stem layout: s2d folds the 224px 7x7/s2 "
+                        "stem conv into an exact 4x4/s1 conv over "
+                        "space-to-depth (112x112x12) input — same math, "
+                        "MXU-shaped (ignored by CIFAR-stem models)")
     p.add_argument("--resident_scoring_bytes", type=int, default=None,
-                   help="device-resident pool budget in bytes (default: "
-                        "the arg pool's conservative 2 GB).  On 16 GB "
-                        "chips, size this over the decoded al pool to pin "
-                        "it in HBM after round 0 — later query/eval "
-                        "passes become on-device gathers.  0 disables "
-                        "residency.")
+                   help="device-resident pool budget in bytes.  Default "
+                        "(unset) AUTO-sizes from live HBM headroom at "
+                        "each round start, so pools that fit the chip pin "
+                        "in HBM and later query/eval passes are on-device "
+                        "gathers.  Pass an integer to pin the budget, 0 "
+                        "to disable residency.")
     # Coreset / BADGE scale controls (parser.py:74-79)
     p.add_argument("--subset_labeled", type=int, default=None)
     p.add_argument("--subset_unlabeled", type=int, default=None)
@@ -144,6 +156,8 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         debug_mode=args.debug_mode,
         profile_dir=args.profile_dir,
         dtype=args.dtype,
+        bn_stats_dtype=args.bn_stats_dtype,
+        stem=args.stem,
         resident_scoring_bytes=args.resident_scoring_bytes,
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,

@@ -132,15 +132,20 @@ def build_experiment(
     num_classes = al_set.num_classes
 
     if model is None:
-        # --dtype beats the arg pool's TrainConfig.dtype; "auto" lands on
-        # bfloat16 when the live backend is TPU (models/factory.py).
+        # --dtype/--stem/--bn_stats_dtype beat the arg pool's TrainConfig;
+        # "auto" dtype lands on bfloat16 when the live backend is TPU, and
+        # auto BN stats follow the compute dtype (models/factory.py).
         model = get_network(cfg.dataset, cfg.model,
                             freeze_feature=cfg.freeze_feature,
                             num_classes=num_classes,
-                            dtype=cfg.dtype or train_cfg.dtype)
+                            dtype=cfg.dtype or train_cfg.dtype,
+                            stem=cfg.stem or train_cfg.stem,
+                            bn_stats_dtype=(cfg.bn_stats_dtype
+                                            or train_cfg.bn_stats_dtype))
     if cfg.resident_scoring_bytes is not None:
         # --resident_scoring_bytes beats the arg pool: HBM sizing is a
-        # per-chip deployment choice, not a dataset hyperparameter.
+        # per-chip deployment choice, not a dataset hyperparameter.  (The
+        # arg-pool default is None = auto-size from live HBM headroom.)
         train_cfg = dataclasses.replace(
             train_cfg, resident_scoring_bytes=cfg.resident_scoring_bytes)
     if mesh is None:
@@ -252,6 +257,15 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         for rd in range(start_round, cfg.rounds):
             strategy.round = rd
             logger.info(f"Active Learning Round {rd} start.")
+            # Pool residency is default behavior: re-size the auto budget
+            # from live HBM headroom at every round start (a no-op for
+            # explicit integer budgets; already-uploaded pools stay
+            # resident regardless — parallel/resident.cached).
+            budget = strategy.trainer.refresh_resident_budget()
+            logger.info(
+                f"Resident pool budget for round {rd}: "
+                f"{budget / 1e9:.2f} GB "
+                f"({'auto' if strategy.train_cfg.resident_scoring_bytes is None else 'explicit'})")
 
             # Round 0 only queries when there is no initial pool — with an
             # SSL or transfer-learned init the model can score the pool

@@ -44,6 +44,18 @@ def resolve_dtype(spec: Any) -> Any:
                 f"{sorted(_DTYPE_NAMES)} or 'auto'")
     return spec
 
+
+def resolve_bn_stats_dtype(spec: Any, compute_dtype: Any) -> Any:
+    """BN-statistics read precision: "auto" follows the COMPUTE dtype —
+    bf16 models get the fused bf16-read/f32-accumulate statistics path
+    (models/resnet.FusedBatchNorm), f32 models keep flax's BatchNorm so
+    CPU/parity numerics are untouched.  Accumulation and the stored
+    running statistics are float32 in every mode."""
+    if spec is None or spec == "auto":
+        return jnp.bfloat16 if compute_dtype == jnp.bfloat16 else None
+    resolved = resolve_dtype(spec)
+    return jnp.bfloat16 if resolved == jnp.bfloat16 else None
+
 # Dataset -> class count (get_networks.py:3-6).
 DATASET_NUM_CLASSES = {
     "cifar10": 10,
@@ -60,6 +72,8 @@ def get_network(
     freeze_feature: bool = False,
     num_classes: Optional[int] = None,
     dtype: Any = "auto",
+    stem: str = "default",
+    bn_stats_dtype: Any = "auto",
 ) -> SSLClassifier:
     if num_classes is None:
         try:
@@ -71,5 +85,14 @@ def get_network(
     # The reference applies the SimCLR CIFAR stem whenever num_classes == 10
     # (resnet_simclr.py:17-18); keep that behavior.
     cifar_stem = num_classes == 10
+    if stem in (None, "auto"):
+        stem = "default"
+    if stem == "s2d" and cifar_stem:
+        # The CLI/arg-pool stem choice is global; CIFAR datasets keep their
+        # SimCLR stem (there is no 7x7 conv to fold) rather than erroring.
+        stem = "default"
+    compute = resolve_dtype(dtype)
     return factory(num_classes=num_classes, cifar_stem=cifar_stem,
-                   freeze_feature=freeze_feature, dtype=resolve_dtype(dtype))
+                   freeze_feature=freeze_feature, dtype=compute, stem=stem,
+                   bn_stats_dtype=resolve_bn_stats_dtype(bn_stats_dtype,
+                                                         compute))

@@ -24,6 +24,25 @@ Design notes (TPU-first, not a translation):
     reduction lowers to a cross-replica collective automatically, giving
     SyncBatchNorm semantics (reference: strategy.py:292) with no special
     wrapper.
+  * Space-to-depth stem (``stem="s2d"``): the 224px 7x7/s2 stem conv is an
+    arithmetic-intensity sink on the 128x128 MXU (3 input channels leave
+    126/128 of the contraction lanes idle).  Re-laying the input as
+    112x112x12 (2x2 pixel blocks flattened into channels) and folding the
+    7x7/s2 kernel into an exact 4x4/s1 kernel computes the identical
+    convolution with 12 contraction channels — same multiplies, MXU-shaped
+    (``s2d_stem_kernel`` is the exact weight transform; pinned bit-level by
+    tests/test_s2d_stem.py).  The layout transform itself can run host-side
+    (data/pipeline.space_to_depth — same byte count over PCIe) or on device
+    (free reshape, fused); the encoder accepts either form.
+  * Fused bf16 BN statistics (``bn_stats_dtype``): flax's BatchNorm promotes
+    the FULL activation tensor to float32 before its mean/var reductions —
+    on a bf16 model that materializes a 2x-size tensor between the conv and
+    the stats pass and breaks producer fusion (measured -23% of forward
+    throughput, mfu_decomposition.json).  ``FusedBatchNorm`` reduces the
+    bf16 activations directly with float32 ACCUMULATION (jnp.mean's dtype
+    argument lowers to a bf16-read/f32-accumulate XLA reduce), so the stats
+    pass reads half the bytes and fuses with its producer.  Parameters and
+    running statistics stay float32 either way.
 """
 
 from __future__ import annotations
@@ -36,6 +55,125 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 ModuleDef = Any
+
+# Space-to-depth block size for the 224px stem: 2x2 pixel blocks -> 12
+# channels, turning the 7x7/s2 stem into a 4x4/s1 conv (see module
+# docstring).  The channel order within a block is (di, dj, c) row-major —
+# data/pipeline.space_to_depth, space_to_depth() below, and
+# s2d_stem_kernel() must all agree on it.
+S2D_BLOCK = 2
+
+
+def space_to_depth(x: jnp.ndarray, block: int = S2D_BLOCK) -> jnp.ndarray:
+    """[B, H, W, C] -> [B, H/b, W/b, b*b*C]; works on jnp and np arrays
+    (pure reshape/transpose).  Channel index = (di * b + dj) * C + c."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, block * block * c)
+
+
+def s2d_stem_kernel(kernel7: jnp.ndarray) -> jnp.ndarray:
+    """Fold a [7, 7, C, F] stride-2/pad-3 stem kernel into the exact
+    [4, 4, 4C, F] stride-1 kernel over space-to-depth input.
+
+    Derivation: output(i,j) sums W[a,b,c]·X[2i+a-3, 2j+b-3, c].  Writing
+    the input row as u = 2p + di (p the s2d row, di the in-block offset)
+    gives a = 2r + di - 1 for s2d tap r = p - i + 2 ∈ 0..3 — i.e. pad the
+    kernel to 8x8 with one leading zero row/col, then regroup [4,2,4,2]
+    into taps x in-block offsets.  Pure re-indexing: every product of the
+    7x7 conv appears exactly once (plus 4C·F structural zeros), so the
+    convolution is exact in every dtype.
+    """
+    kh, kw, c, f = kernel7.shape
+    assert (kh, kw) == (7, 7), f"stem kernel must be 7x7, got {kh}x{kw}"
+    padded = jnp.pad(jnp.asarray(kernel7),
+                     ((1, 0), (1, 0), (0, 0), (0, 0)))
+    k = padded.reshape(4, 2, 4, 2, c, f)          # [r, di, s, dj, c, f]
+    k = k.transpose(0, 2, 1, 3, 4, 5)             # [r, s, di, dj, c, f]
+    return k.reshape(4, 4, 4 * c, f)
+
+
+def stem_kernel_from_s2d(kernel4: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of ``s2d_stem_kernel``: [4, 4, 4C, F] -> [7, 7, C, F]
+    (drops the structural zero row/col)."""
+    kh, kw, c4, f = kernel4.shape
+    assert (kh, kw) == (4, 4) and c4 % 4 == 0
+    c = c4 // 4
+    k = kernel4.reshape(4, 4, 2, 2, c, f)         # [r, s, di, dj, c, f]
+    k = k.transpose(0, 2, 1, 3, 4, 5)             # [r, di, s, dj, c, f]
+    return k.reshape(8, 8, c, f)[1:, 1:]
+
+
+class FusedBatchNorm(nn.Module):
+    """Drop-in BatchNorm whose batch statistics read the activations in
+    their COMPUTE dtype (bf16) with float32 accumulation, instead of
+    flax's materialize-as-float32-then-reduce (see module docstring).
+
+    Same collections and semantics as the ``nn.BatchNorm`` usage in this
+    file: float32 scale/bias params, float32 running mean/var in
+    ``batch_stats``, fast-variance formula E[x²]−E[x]² (flax's
+    ``use_fast_variance=True`` default), momentum-0.9 EMA update.
+    """
+
+    use_running_average: Optional[bool] = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None  # accepted for API parity; unused
+    scale_init: Callable = nn.initializers.ones
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        features = x.shape[-1]
+        axes = tuple(range(x.ndim - 1))
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda s: jnp.zeros(s, jnp.float32),
+                                (features,))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda s: jnp.ones(s, jnp.float32),
+                               (features,))
+        scale = self.param("scale", self.scale_init, (features,),
+                           jnp.float32)
+        bias = self.param("bias", self.bias_init, (features,), jnp.float32)
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            x_stats = x.astype(self.dtype)
+            # bf16 element reads, float32 accumulators: the ``dtype``
+            # argument (and the in-reduce f32 convert below) set the XLA
+            # reduce's element/accumulation type without materializing a
+            # float32 copy — the convert fuses into the reduction's read.
+            # The SQUARE must happen in f32: squaring bf16 values first
+            # would feed E[x²]−E[x]² a ~2⁻⁹-relative-error term that the
+            # cancellation amplifies into a garbage (clamped-to-zero)
+            # variance whenever mean² ≫ var.
+            mean = jnp.mean(x_stats, axes, dtype=jnp.float32)
+            mean2 = jnp.mean(
+                jax.lax.square(x_stats.astype(jnp.float32)), axes)
+            var = jnp.maximum(mean2 - jax.lax.square(mean), 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+
+        mul = (scale * jax.lax.rsqrt(var + self.epsilon)).astype(self.dtype)
+        sub = (mean.astype(self.dtype) * mul - bias.astype(self.dtype))
+        return x.astype(self.dtype) * mul - sub
+
+
+# Flax auto-names unnamed submodules by CLASS name; the residual blocks'
+# norms must keep their "BatchNorm_N" paths so checkpoints (and the torch
+# overlay map in utils/pretrained.py) are identical whichever statistics
+# path a model was built with — a bf16-stats training run must restore
+# into an f32-stats eval model and vice versa.
+FusedBatchNorm.__name__ = "BatchNorm"
+FusedBatchNorm.__qualname__ = "BatchNorm"
 
 # torch init_params semantics (src/models/utils.py:5-18): conv weights
 # kaiming-normal fan_out, linear weights N(0, 1e-3), biases zero.  BatchNorm
@@ -112,6 +250,8 @@ class ResNetEncoder(nn.Module):
     block_cls: ModuleDef
     num_filters: int = 64
     cifar_stem: bool = False
+    stem: str = "default"  # "default" | "s2d" (224px path only)
+    bn_stats_dtype: Any = None  # None/f32 -> flax BatchNorm; bf16 -> fused
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -119,8 +259,10 @@ class ResNetEncoder(nn.Module):
         conv = functools.partial(
             nn.Conv, use_bias=False, dtype=self.dtype,
             kernel_init=conv_kernel_init)
+        fused_stats = self.bn_stats_dtype == jnp.bfloat16
         norm = functools.partial(
-            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            FusedBatchNorm if fused_stats else nn.BatchNorm,
+            use_running_average=not train, momentum=0.9,
             epsilon=1e-5, dtype=self.dtype, axis_name=None)
 
         x = x.astype(self.dtype)
@@ -130,6 +272,21 @@ class ResNetEncoder(nn.Module):
             x = conv(self.num_filters, (3, 3), (1, 1), name="conv_stem")(x)
             x = norm(name="bn_stem")(x)
             x = nn.relu(x)
+        elif self.stem == "s2d":
+            if x.shape[-1] == 3:
+                # Host didn't pre-transform (resident pools, epoch-scan
+                # gathers): the layout change is a free on-device reshape
+                # that XLA fuses with the conv's input read.
+                x = space_to_depth(x)
+            # Exact refactoring of the 7x7/s2 stem: 4x4/s1 over 2x2-block
+            # channels, explicit (2, 1) padding = the 7x7's pad-3 window
+            # in s2d coordinates (see s2d_stem_kernel).
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     padding=[(2, 1), (2, 1)], name="conv_stem")(x)
+            x = norm(name="bn_stem")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                            padding=[(1, 1), (1, 1)])
         else:
             x = conv(self.num_filters, (7, 7), (2, 2),
                      padding=[(3, 3), (3, 3)], name="conv_stem")(x)
@@ -166,13 +323,17 @@ class SSLClassifier(nn.Module):
     block_cls: ModuleDef
     num_classes: int
     cifar_stem: bool = False
+    stem: str = "default"
+    bn_stats_dtype: Any = None
     freeze_feature: bool = False
     dtype: Any = jnp.float32
 
     def setup(self):
         self.encoder = ResNetEncoder(
             stage_sizes=self.stage_sizes, block_cls=self.block_cls,
-            cifar_stem=self.cifar_stem, dtype=self.dtype, name="encoder")
+            cifar_stem=self.cifar_stem, stem=self.stem,
+            bn_stats_dtype=self.bn_stats_dtype, dtype=self.dtype,
+            name="encoder")
         self.linear = nn.Dense(
             self.num_classes, kernel_init=dense_kernel_init,
             bias_init=nn.initializers.zeros, name="linear")
@@ -199,22 +360,30 @@ class SSLClassifier(nn.Module):
 
 
 def _make(stage_sizes, block_cls, num_classes, cifar_stem, freeze_feature,
-          dtype):
+          dtype, stem, bn_stats_dtype):
+    if stem == "s2d" and cifar_stem:
+        raise ValueError("the s2d stem refactors the 7x7/s2 ImageNet stem; "
+                         "the CIFAR stem (3x3/s1) has nothing to fold")
+    if stem not in ("default", "s2d"):
+        raise ValueError(f"unknown stem {stem!r}; expected 'default'/'s2d'")
     return SSLClassifier(
         stage_sizes=tuple(stage_sizes), block_cls=block_cls,
-        num_classes=num_classes, cifar_stem=cifar_stem,
-        freeze_feature=freeze_feature, dtype=dtype)
+        num_classes=num_classes, cifar_stem=cifar_stem, stem=stem,
+        bn_stats_dtype=bn_stats_dtype, freeze_feature=freeze_feature,
+        dtype=dtype)
 
 
 def resnet18(num_classes: int, cifar_stem: bool = False,
-             freeze_feature: bool = False,
-             dtype: Any = jnp.float32) -> SSLClassifier:
+             freeze_feature: bool = False, dtype: Any = jnp.float32,
+             stem: str = "default",
+             bn_stats_dtype: Any = None) -> SSLClassifier:
     return _make([2, 2, 2, 2], BasicBlock, num_classes, cifar_stem,
-                 freeze_feature, dtype)
+                 freeze_feature, dtype, stem, bn_stats_dtype)
 
 
 def resnet50(num_classes: int, cifar_stem: bool = False,
-             freeze_feature: bool = False,
-             dtype: Any = jnp.float32) -> SSLClassifier:
+             freeze_feature: bool = False, dtype: Any = jnp.float32,
+             stem: str = "default",
+             bn_stats_dtype: Any = None) -> SSLClassifier:
     return _make([3, 4, 6, 3], BottleneckBlock, num_classes, cifar_stem,
-                 freeze_feature, dtype)
+                 freeze_feature, dtype, stem, bn_stats_dtype)

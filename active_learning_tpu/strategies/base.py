@@ -313,11 +313,21 @@ class Strategy:
 
     def _resident_kwargs(self) -> Dict:
         """collect_pool kwargs for the device-resident pool: one gating
-        convention (resident_scoring_bytes == 0 disables) for every
-        sampler, including VAAL's own scoring pass."""
-        rb = self.train_cfg.resident_scoring_bytes
-        return {"resident_cache": self._resident_pool if rb else None,
-                "resident_max_bytes": rb}
+        convention (a resolved budget of 0 disables) for every sampler,
+        including VAAL's own scoring pass.  The budget is the TRAINER'S
+        resolved one (auto-sized from HBM headroom when the config is
+        None — pool residency is the default, not an override), and the
+        host fallback pre-transforms batches for s2d-stem models."""
+        rb = self.trainer.resident_budget
+        # A pool pinned before an auto-budget refresh shrank rb to 0 must
+        # keep its fast path (same rule as trainer.evaluate): its bytes
+        # stay in HBM either way, so streaming would pay twice.
+        have_pinned = bool(self._resident_pool.get("images"))
+        return {"resident_cache": (self._resident_pool
+                                   if rb or have_pinned else None),
+                "resident_max_bytes": rb,
+                "host_s2d": getattr(self.model, "stem",
+                                    "default") == "s2d"}
 
 
 def register_strategy(name: str):

@@ -261,11 +261,13 @@ def make_mase_step(model, view: ViewSpec) -> Callable:
 
 # In-memory pools up to this size stay resident on device across ALL
 # rounds and samplers (uint8, replicated like the trainer's epoch-scan
-# arrays; the per-batch gather output is what gets data-sharded).  The
-# single source of the default is the config module (TrainConfig's
-# resident_scoring_bytes field uses the same constant); the shared pool
-# cache + jitted gather-runners live in parallel/resident.py so scoring
-# and evaluation upload each pool exactly once between them.
+# arrays; the per-batch gather output is what gets data-sharded).  This
+# constant is only the DIRECT-CALLER default: production callers pass
+# the trainer's resolved budget, which auto-sizes from live HBM headroom
+# when TrainConfig.resident_scoring_bytes is None
+# (parallel/resident.resolve_budget).  The shared pool cache + jitted
+# gather-runners live in parallel/resident.py so scoring and evaluation
+# upload each pool exactly once between them.
 from ..config import RESIDENT_SCORING_BYTES_DEFAULT as RESIDENT_MAX_BYTES
 from ..parallel import resident as resident_lib
 
@@ -291,6 +293,7 @@ def collect_pool(
     keys: Optional[Iterable[str]] = None,
     resident_cache: Optional[Dict] = None,
     resident_max_bytes: int = RESIDENT_MAX_BYTES,
+    host_s2d: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Run ``step_fn`` over ``dataset[idxs]`` in fixed-shape sharded batches
     and return host arrays of length ``len(idxs)``, row i scoring pool index
@@ -314,9 +317,12 @@ def collect_pool(
     # Device-resident fast path for in-memory pools: upload once per
     # experiment (the caller owns ``resident_cache``), then every batch of
     # every round's every sampler is an on-device gather — zero image
-    # bytes cross the host<->device boundary after the first round.
+    # bytes cross the host<->device boundary after the first round.  A
+    # pool that is ALREADY uploaded keeps its fast path even if a budget
+    # refresh shrank the budget below its size (resident_lib.cached).
     if (resident_cache is not None
-            and resident_lib.eligible(dataset, resident_max_bytes)):
+            and (resident_lib.eligible(dataset, resident_max_bytes)
+                 or resident_lib.cached(resident_cache, dataset))):
         images_dev, _ = resident_lib.pool_arrays(resident_cache, dataset,
                                                  mesh)
         run = resident_lib.get_runner(resident_cache, step_fn, mesh)
@@ -366,18 +372,31 @@ def collect_pool(
                 chunks.setdefault(k, []).append(np.asarray(merged))
                 v.clear()
 
-    for i, batch in enumerate(iterate_batches(
-            dataset, idxs, batch_size, num_threads=num_workers,
-            prefetch=prefetch, local=local)):
-        # The threaded prefetcher must deliver batches in order, and this
-        # process's rows must be exactly its slice of the global layout —
-        # the class of bug the reference has at confidence_sampler.py:41
-        # (scores sorted by a scrambled index) cannot pass silently here.
-        if not np.array_equal(batch["index"],
-                              layouts[i][local].astype(np.int32)):
-            raise AssertionError(
-                "scoring rows misaligned with the global batch layout")
-        out = step_fn(variables, mesh_lib.shard_batch(batch, mesh))
+    def checked_host_batches():
+        for i, batch in enumerate(iterate_batches(
+                dataset, idxs, batch_size, num_threads=num_workers,
+                prefetch=prefetch, local=local, s2d=host_s2d)):
+            # The threaded prefetcher must deliver batches in order, and
+            # this process's rows must be exactly its slice of the global
+            # layout — the class of bug the reference has at
+            # confidence_sampler.py:41 (scores sorted by a scrambled
+            # index) cannot pass silently here.
+            if not np.array_equal(batch["index"],
+                                  layouts[i][local].astype(np.int32)):
+                raise AssertionError(
+                    "scoring rows misaligned with the global batch layout")
+            yield batch
+
+    # Async double-buffered host->device feed (data/cache.device_prefetch):
+    # the gather/decode AND the h2d dispatch of batch n+1 overlap batch
+    # n's device compute, so a pool too big for residency is bounded by
+    # max(host feed, PCIe, device) instead of their sum — the fallback
+    # leg of the pool-residency default.
+    from ..data.cache import device_prefetch
+    for i, sharded in enumerate(device_prefetch(
+            checked_host_batches(),
+            lambda b: mesh_lib.shard_batch(b, mesh))):
+        out = step_fn(variables, sharded)
         if keys is not None:
             out = {k: out[k] for k in keys}
         for k, v in out.items():

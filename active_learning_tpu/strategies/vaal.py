@@ -317,10 +317,15 @@ class VAALSampler(Strategy):
                      "vae_stats": self.vaal_state.vae_stats,
                      "d_params": self.vaal_state.d_params}
         loader = self.train_cfg.loader_te
+        resident_kwargs = self._resident_kwargs()
+        # VAAL scores with its VAE/discriminator, not the classifier: the
+        # VAE is 3-channel, so an s2d-stem classifier must not switch the
+        # host feed to space-to-depth batches here.
+        resident_kwargs["host_s2d"] = False
         out = scoring.collect_pool(
             self.al_set, idxs, self._score_batch_size(), self._score_step,
             variables, self.mesh, num_workers=loader.num_workers,
-            prefetch=loader.prefetch, **self._resident_kwargs())
+            prefetch=loader.prefetch, **resident_kwargs)
         budget = int(min(len(idxs), budget))
         order = np.argsort(out["d_score"], kind="stable")[:budget]
         self.logger.info(f"Number of queried images: {budget}")

@@ -99,6 +99,10 @@ class Trainer:
             train_bn = not (model.freeze_feature or train_cfg.has_pretrained)
         self.train_bn = train_bn
         self.n_devices = mesh.devices.size
+        # Host-side space-to-depth for streamed (host-batched) paths: the
+        # s2d model accepts either layout, so resident/epoch-scan gathers
+        # stay raw 3-channel and transform on device for free.
+        self._host_s2d = getattr(model, "stem", "default") == "s2d"
         self._train_step = self._build_train_step()
         self._chained_train_step = self._build_chained_train_step()
         self._epoch_scan: Optional[Callable] = None  # built on first use
@@ -107,8 +111,25 @@ class Trainer:
         # between evaluation (here) and acquisition scoring (the Strategy
         # passes it into collect_pool): pools keyed by their UNDERLYING
         # images array, so al/train views sharing storage upload once and
-        # the resident_scoring_bytes budget is per-array, not per-consumer.
+        # the resident budget is per-array, not per-consumer.
         self.resident_pool: Dict[Any, Any] = {}
+        # Concrete resident-pool byte budget: config None = AUTO-sized
+        # from live HBM headroom (parallel/resident.resolve_budget);
+        # refresh_resident_budget() re-sizes it at round start.
+        from ..parallel import resident as resident_lib
+        self.resident_budget = resident_lib.resolve_budget(
+            train_cfg.resident_scoring_bytes)
+
+    def refresh_resident_budget(self) -> int:
+        """Re-size the AUTO resident budget from current HBM headroom
+        (called by the driver at round start; explicit integer configs are
+        left alone).  Pools already uploaded stay resident regardless —
+        their bytes are already counted in bytes_in_use, so a post-upload
+        refresh must not evict them (parallel/resident.cached)."""
+        from ..parallel import resident as resident_lib
+        if self.cfg.resident_scoring_bytes is None:
+            self.resident_budget = resident_lib.resolve_budget(None)
+        return self.resident_budget
 
     # -- setup -----------------------------------------------------------
 
@@ -359,7 +380,8 @@ class Trainer:
         variables = state.variables
 
         from ..parallel import resident as resident_lib
-        if resident_lib.eligible(dataset, self.cfg.resident_scoring_bytes):
+        if (resident_lib.eligible(dataset, self.resident_budget)
+                or resident_lib.cached(self.resident_pool, dataset)):
             # Device-resident path: on-device row gather per batch, count
             # totals accumulated ON DEVICE (one host fetch at the end) so
             # async dispatch pipelines the whole eval pass; see
@@ -386,7 +408,8 @@ class Trainer:
             for batch in iterate_batches(
                     dataset, idxs, bs,
                     num_threads=self.cfg.loader_te.num_workers,
-                    prefetch=self.cfg.loader_te.prefetch, local=local):
+                    prefetch=self.cfg.loader_te.prefetch, local=local,
+                    s2d=self._host_s2d):
                 yield eval_step(variables,
                                 mesh_lib.shard_batch(batch, self.mesh))
 
@@ -567,11 +590,14 @@ class Trainer:
                 epoch_loss = jnp.sum(losses) / steps_real
             else:
                 losses = []
+                # Host-side s2d only without a batch_hook: VAAL's hook
+                # feeds the same sharded batch to its 3-channel VAE.
                 for batch in iterate_batches(
                         train_set, labeled_idxs, bs, shuffle=True, rng=rng,
                         num_threads=self.cfg.loader_tr.num_workers,
                         prefetch=self.cfg.loader_tr.prefetch,
-                        local=mesh_lib.process_local_rows(self.mesh, bs)):
+                        local=mesh_lib.process_local_rows(self.mesh, bs),
+                        s2d=self._host_s2d and batch_hook is None):
                     sharded = mesh_lib.shard_batch(batch, self.mesh)
                     state, key, loss = self._chained_train_step(
                         state, sharded, key, lr, class_weights,

@@ -183,6 +183,13 @@ def overlay_torch_state(variables: Dict[str, Any],
             raise KeyError(
                 f"Checkpoint key '{key}' maps to {'/'.join(path)}, absent "
                 f"from the model (wrong depth/variant?)")
+        if (path[-2:] == ("conv_stem", "kernel") and arr.shape[:2] == (7, 7)
+                and tuple(flat[path].shape)[:2] == (4, 4)):
+            # s2d-stem model consuming a standard 7x7-stem checkpoint:
+            # fold the kernel exactly (models/resnet.s2d_stem_kernel) —
+            # the loaded network computes the identical convolution.
+            from ..models.resnet import s2d_stem_kernel
+            arr = np.asarray(s2d_stem_kernel(arr))
         if tuple(flat[path].shape) != tuple(arr.shape):
             raise ValueError(
                 f"Shape mismatch for '{key}' -> {'/'.join(path)}: "

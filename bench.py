@@ -90,14 +90,21 @@ PEAK_TFLOPS_BF16 = [
     ("v6", 918.0), ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
 ]
 
+# Where the cache/partial/evidence files live: the repo dir by default;
+# AL_BENCH_STATE_DIR redirects all three so tests (and parallel bench
+# invocations) can exercise the full emit path without touching the real
+# captured evidence (tests/test_bench_json.py pins the degraded-mode
+# JSON-line guarantee through this).
+_STATE_DIR = (os.environ.get("AL_BENCH_STATE_DIR")
+              or os.path.dirname(os.path.abspath(__file__)))
+
 # Successful phase results are persisted here (with a capture timestamp)
 # and reused — marked "cached": true — when a later invocation can't
 # capture that phase fresh.  The tunneled TPU backend's availability is
 # highly variable (whole-phase timeouts minutes apart from 3.5-minute
 # successes), and a flaky tunnel at harness time must not erase real
 # numbers captured hours earlier on the same hardware.
-CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "bench_cache.json")
+CACHE_PATH = os.path.join(_STATE_DIR, "bench_cache.json")
 
 PHASES = [
     # (name, iters, per-chip batch, first-attempt timeout seconds).
@@ -112,7 +119,13 @@ PHASES = [
     # native C++ decode + the mesh-parallel scoring pass.  iters is in
     # THOUSANDS of images so the retry halving shrinks the tree.
     ("imagenet_datapath", 50, 128, 900),
-    ("resnet18_cifar_score", 30, 256, 420),
+    # PRIMARY at the 512-rows/chip production floor (trainer.py
+    # eval_batch_size: <=64px rows score at 512/chip — +47% measured over
+    # 256); the automatic alt probe then covers 1024 as the beyond-floor
+    # data point.  Earlier rounds captured 256 primary / 512 alt, so the
+    # README's production number came from the alt probe — now it IS the
+    # primary capture.
+    ("resnet18_cifar_score", 30, 512, 420),
     # The selection hot loop (SURVEY hard part (a)): greedy k-center over
     # a 50k-row, 2048-dim pool — the reference's paper protocol subsets
     # the pool to 50k and picks 10k per round (gen_jobs.py:8-13).  iters
@@ -123,6 +136,18 @@ PHASES = [
     # that the reference can only handle partitioned — this phase times
     # the full-pool no-partition scan and records peak HBM.
     ("kcenter_select_130k", 10000, 128, 900),
+    # Where does no-partition selection actually stop?  Climb + bisect
+    # toward the FULL 1.28M x 2048 f32 factor matrix, recording picks/s
+    # and peak HBM at each pool size; the largest completed N is the
+    # measured envelope DESIGN.md §3's analytic one must match.  iters is
+    # the per-attempt pick budget (small: the question is residency, not
+    # selection throughput).
+    ("kcenter_select_maxn", 256, 128, 900),
+    # First on-TPU VAAL execution record: one VAE+discriminator co-train
+    # epoch over the synthetic in-memory pool through the production
+    # VAALSampler step, with finite-loss/learning assertions.  iters is
+    # the epoch count.
+    ("vaal_cotrain", 1, 64, 600),
     # BASELINE.md metric #1: real end-to-end AL rounds through the
     # production driver.  iters is the per-round epoch count.
     ("al_round_cifar", 4, 128, 900),
@@ -140,11 +165,9 @@ TOTAL_BUDGET_S = float(os.environ.get("AL_BENCH_BUDGET_S", "1400"))
 PROBE_DEGRADED_S = 60.0
 # The would-be-final JSON is rewritten here after every phase, so even a
 # SIGKILL mid-run leaves complete evidence of everything captured so far.
-PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "bench_partial.json")
+PARTIAL_PATH = os.path.join(_STATE_DIR, "bench_partial.json")
 # The FULL final evidence lands here; the stdout line only references it.
-EVIDENCE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "bench_evidence.json")
+EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # Hard bound on the ONE stdout line: the consuming harness records a
 # ~2,000-byte tail, so the line must fit with margin no matter how many
 # phases, failures, or extras it carries (enforced by staged truncation
@@ -174,16 +197,39 @@ def _model_and_views(config: str):
                                                ViewSpec)
     from active_learning_tpu.models.resnet import resnet18, resnet50
 
+    # The bench measures the production bf16 configuration: fused bf16 BN
+    # statistics (TrainConfig.bn_stats_dtype "auto" on a bf16 model) and,
+    # for the 224px model, the space-to-depth stem.  AL_BENCH_S2D=0 /
+    # AL_BENCH_BN_STATS=f32 restore the old stem/stats for A/Bs.
+    s2d = os.environ.get("AL_BENCH_S2D", "1") != "0"
+    bf16_stats = os.environ.get("AL_BENCH_BN_STATS", "bf16") != "f32"
+    bn_stats = jnp.bfloat16 if bf16_stats else None
     if config == "resnet50_imagenet":
-        model = resnet50(num_classes=1000, dtype=jnp.bfloat16)
+        model = resnet50(num_classes=1000, dtype=jnp.bfloat16,
+                         stem="s2d" if s2d else "default",
+                         bn_stats_dtype=bn_stats)
         # ImageNet: crop happens at decode; the device view only flips
         # (data/imagenet.py:257).
         return (model, 224, 1000,
                 ViewSpec(IMAGENET_NORM, augment=True, pad=0),
                 ViewSpec(IMAGENET_NORM, augment=False))
-    model = resnet18(num_classes=10, cifar_stem=True, dtype=jnp.bfloat16)
+    model = resnet18(num_classes=10, cifar_stem=True, dtype=jnp.bfloat16,
+                     bn_stats_dtype=bn_stats)
     return (model, 32, 10, ViewSpec(CIFAR10_NORM, augment=True, pad=4),
             ViewSpec(CIFAR10_NORM, augment=False))
+
+
+def _model_config_fields(model) -> dict:
+    """The stem/BN-stats configuration a train/score phase measured —
+    recorded in the phase JSON so every number is attributable to its
+    compute configuration."""
+    import jax.numpy as jnp
+    return {
+        "s2d": getattr(model, "stem", "default") == "s2d",
+        "bn_stats_dtype": ("bfloat16"
+                          if getattr(model, "bn_stats_dtype", None)
+                          == jnp.bfloat16 else "float32"),
+    }
 
 
 def _ensure_jpeg_tree(root: str, n_images: int, n_classes: int = 100
@@ -355,6 +401,7 @@ def _datapath_model_passes(result, dataset, cached_set, batch_size,
 
     n_chips = result["n_chips"]
     model, _, _, _, score_view = _model_and_views("resnet50_imagenet")
+    result.update(_model_config_fields(model))
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((8, 224, 224, 3), jnp.float32),
                            train=False)
@@ -610,6 +657,206 @@ def run_kcenter_pallas_ab(budget: int, auto_result: dict,
     return result
 
 
+def run_kcenter_maxn_phase(budget: int, dim: int = 2048):
+    """Climb + bisect toward the largest pool the no-partition k-center
+    scan completes: 160k -> 320k -> 640k -> 1.28M rows of [N, 2048] f32
+    factors (1.28M x 2048 x 4 = 10.5 GB — the FULL ImageNet pool), with a
+    couple of bisection steps between the last success and the first
+    failure.  Each attempt records picks/s and peak HBM, so DESIGN.md
+    §3's analytic no-partition envelope gets a measured boundary; the
+    failure mode past the envelope (RESOURCE_EXHAUSTED) is recorded, not
+    fatal.  GENERATOR: yields after every completed attempt so a timeout
+    loses only the unfinished pool size.  CPU backends climb a tiny
+    ladder instead — the envelope question is an HBM question."""
+    import numpy as np
+
+    import jax
+    from active_learning_tpu.strategies.kcenter import kcenter_greedy
+
+    platform = jax.devices()[0].platform
+    device_kind = jax.devices()[0].device_kind
+    if platform == "cpu":
+        ladder = [4096, 8192, 16384]
+        budget = min(budget, 64)
+    else:
+        ladder = [160_000, 320_000, 640_000, 1_280_000]
+    result = {
+        "phase": "kcenter_select_maxn",
+        "ips": None, "ips_per_chip": None, "unit": "picks/sec",
+        "n_chips": 1, "dim": dim, "budget": budget, "max_n": 0,
+        "target_n": ladder[-1], "attempts": [],
+        "device_kind": device_kind, "platform": platform,
+    }
+
+    def attempt(n: int):
+        log(f"[kcenter_select_maxn] trying pool [{n}, {dim}] "
+            f"({n * dim * 4 / 2**30:.1f} GB of factors)")
+        rng = np.random.default_rng(0)
+        # Chunked generation: a 1.28M-row normal draw in one call holds
+        # two 10.5 GB temporaries on the host.
+        emb = np.empty((n, dim), dtype=np.float32)
+        for lo in range(0, n, 131072):
+            hi = min(n, lo + 131072)
+            emb[lo:hi] = rng.standard_normal(
+                (hi - lo, dim), dtype=np.float32)
+        labeled = np.zeros(n, dtype=bool)
+        labeled[rng.choice(n, min(1000, n // 8), replace=False)] = True
+        kcenter_greedy((emb,), labeled, budget,
+                       rng=np.random.default_rng(1))  # compile
+        t0 = time.perf_counter()
+        picks = kcenter_greedy((emb,), labeled, budget,
+                               rng=np.random.default_rng(2))
+        dt = time.perf_counter() - t0
+        assert len(set(picks.tolist())) == budget
+        entry = {"n": n, "ok": True, "ips": round(budget / dt, 1),
+                 "select_sec": round(dt, 2)}
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            peak = stats.get("peak_bytes_in_use")
+            if peak:
+                entry["peak_hbm_gb"] = round(peak / 2**30, 2)
+        except Exception:
+            pass
+        return entry
+
+    def record(entry):
+        result["attempts"].append(entry)
+        if entry["ok"] and entry["n"] > result["max_n"]:
+            result["max_n"] = entry["n"]
+            result["ips"] = result["ips_per_chip"] = entry["ips"]
+
+    lo, hi = 0, None  # largest success / smallest failure
+    for n in ladder:
+        try:
+            entry = attempt(n)
+        except Exception as e:
+            log(f"[kcenter_select_maxn] pool {n} failed: {e!r}")
+            result["attempts"].append({"n": n, "ok": False,
+                                       "error": repr(e)[:160]})
+            hi = n
+            yield dict(result)
+            break
+        record(entry)
+        lo = n
+        yield dict(result)
+    # Two bisection steps sharpen the boundary without unbounded retries.
+    for _ in range(2):
+        if hi is None or hi - lo <= max(lo // 8, 1):
+            break
+        mid = (lo + hi) // 2 // 2048 * 2048
+        if mid <= lo:
+            break
+        try:
+            entry = attempt(mid)
+        except Exception as e:
+            log(f"[kcenter_select_maxn] pool {mid} failed: {e!r}")
+            result["attempts"].append({"n": mid, "ok": False,
+                                       "error": repr(e)[:160]})
+            hi = mid
+            yield dict(result)
+            continue
+        record(entry)
+        lo = mid
+        yield dict(result)
+    result["no_partition_holds_to_n"] = result["max_n"]
+    yield result
+
+
+def run_vaal_phase(epochs: int, per_chip: int):
+    """One VAE+discriminator co-train epoch over the synthetic in-memory
+    pool through the PRODUCTION VAALSampler step (strategies/vaal.py),
+    asserted finite and learning (reconstruction loss falls over the
+    epoch) — the first on-accelerator execution record for the VAAL path;
+    until now it had only CPU-mesh unit tests (tests/test_vaal.py)."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from active_learning_tpu.config import ExperimentConfig
+    from active_learning_tpu.data.pipeline import iterate_batches
+    from active_learning_tpu.data.synthetic import get_data_synthetic
+    from active_learning_tpu.experiment.driver import build_experiment
+    from active_learning_tpu.parallel import mesh as mesh_lib
+
+    n_chips = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
+    smoke = os.environ.get("AL_BENCH_ROUND_SMOKE") == "1"
+    pool_n = 512 if smoke else 4096
+    tmp = tempfile.mkdtemp(prefix="al_bench_vaal_")
+    data = get_data_synthetic(n_train=pool_n, n_test=64)
+    cfg = ExperimentConfig(
+        dataset="synthetic", arg_pool="synthetic", strategy="VAALSampler",
+        rounds=1, round_budget=min(256, pool_n // 4), model="SSLResNet18",
+        n_epoch=epochs, enable_metrics=False, log_dir=tmp, ckpt_path=tmp,
+        exp_hash="bench")
+    strategy = build_experiment(cfg, data=data)
+    strategy.init_network_weights()
+    bs = strategy.trainer.padded_batch_size(per_chip * n_chips)
+    labeled = strategy.already_labeled_idxs()
+    unlabeled = strategy.available_query_idxs(shuffle=False)
+    log(f"[vaal_cotrain] {n_chips}x {device_kind}, pool {pool_n}, "
+        f"batch {bs}, {epochs} epoch(s)")
+
+    def epoch_batches():
+        u_iter = iterate_batches(strategy.train_set, unlabeled, bs)
+        for b_l in iterate_batches(strategy.train_set, labeled, bs):
+            b_u = next(u_iter, None)
+            if b_u is None:
+                u_iter = iterate_batches(strategy.train_set, unlabeled, bs)
+                b_u = next(u_iter)
+            yield b_l, b_u
+
+    key = jax.random.PRNGKey(0)
+    losses = []
+    steps = 0
+    vs = strategy.vaal_state
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        for b_l, b_u in epoch_batches():
+            key, sub = jax.random.split(key)
+            vs, step_losses = strategy._vaal_step(
+                vs, mesh_lib.shard_batch(b_l, strategy.mesh),
+                mesh_lib.shard_batch(b_u, strategy.mesh),
+                sub, jnp.float32(cfg.vaal.lr_vae),
+                jnp.float32(cfg.vaal.lr_discriminator))
+            losses.append(step_losses)  # device scalars; fetched below
+            steps += 1
+    vae = [float(d["vae_loss"]) for d in losses]
+    d_l = [float(d["d_loss"]) for d in losses]
+    dt = time.perf_counter() - t0
+    # The execution-record assertions: every loss finite, and the VAE
+    # actually learned (mean reconstruction+KL over the last quarter of
+    # the epoch below the first quarter).  A violation fails the phase.
+    assert all(np.isfinite(v) for v in vae + d_l), "non-finite VAAL loss"
+    q = max(1, len(vae) // 4)
+    learned = float(np.mean(vae[-q:])) < float(np.mean(vae[:q]))
+    assert learned, (f"VAE loss did not fall: first-quarter "
+                     f"{np.mean(vae[:q]):.3f} vs last {np.mean(vae[-q:]):.3f}")
+    ips = 2 * bs * steps / dt  # labeled + unlabeled rows per step
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "phase": "vaal_cotrain",
+        "ips": round(ips, 1),
+        "ips_per_chip": round(ips / n_chips, 1),
+        "unit": "cotrain images/sec",
+        "n_chips": n_chips,
+        "batch_per_chip": per_chip,
+        "pool_n": pool_n,
+        "steps": steps,
+        "vae_loss_first": round(vae[0], 4),
+        "vae_loss_last": round(vae[-1], 4),
+        "d_loss_first": round(d_l[0], 4),
+        "d_loss_last": round(d_l[-1], 4),
+        "finite_losses": True,
+        "learned": bool(learned),
+        "device_kind": device_kind,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def run_al_round_phase(config: str, epochs: int) -> dict:
     """One REAL end-to-end AL experiment through the production driver —
     BASELINE.md metric #1 ("AL round wall-clock"), mirroring the
@@ -715,10 +962,24 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         f"(compile cache {'warm' if cache_prewarmed else 'cold'})")
     t0 = time.perf_counter()
     try:
-        run_experiment(cfg, sink=sink, data=data, train_cfg=train_cfg)
+        strategy = run_experiment(cfg, sink=sink, data=data,
+                                  train_cfg=train_cfg)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     total_sec = time.perf_counter() - t0
+    # Residency attribution: whether the pool actually pinned in HBM
+    # (auto-sized budget) or the query streamed through the async
+    # double-buffered prefetch fallback — the phase's query_time is
+    # meaningless without knowing which feed path produced it.
+    pinned = len((strategy.trainer.resident_pool or {}).get("images", {}))
+    residency = {
+        "mode": "resident" if pinned else "prefetch",
+        "pinned_arrays": pinned,
+        "resident_budget_bytes": int(strategy.trainer.resident_budget),
+        "budget_source": ("auto"
+                          if train_cfg.resident_scoring_bytes is None
+                          else "explicit"),
+    }
 
     def phase_sec(name, rd):
         for k, v, step in sink.metrics:
@@ -760,6 +1021,8 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         "compile_tax_sec": round(cold - warm, 2),
         "compile_cache_prewarmed": cache_prewarmed,
         "total_sec": round(total_sec, 1),
+        "residency": residency,
+        **_model_config_fields(strategy.model),
         "phases_sec": rounds,
         "test_accuracy_rd1": test_acc,
         "device_kind": device_kind,
@@ -942,6 +1205,12 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         result["phase"] = phase
         yield result
         return
+    if phase == "kcenter_select_maxn":
+        yield from run_kcenter_maxn_phase(iters)
+        return
+    if phase == "vaal_cotrain":
+        yield run_vaal_phase(iters, per_chip)
+        return
     config, kind = phase.rsplit("_", 1)
     n_chips = len(jax.devices())
     batch_size = per_chip * n_chips
@@ -998,6 +1267,7 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         "iters": iters,
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
+        **_model_config_fields(model),
     }
     if profile_dir:
         result["profiled"] = True  # trace overhead in dt: never cached
@@ -1268,6 +1538,7 @@ def _finalize() -> dict:
     probe = _STATE["probe"] or {}
     hw = ((probe.get("device_kind"), probe.get("n_devices"))
           if probe.get("ok") else None)
+    configured_batch = {name: per_chip for name, _, per_chip, _ in PHASES}
     for name, _, _, _ in PHASES:
         if name in phases or name not in cache:
             continue
@@ -1277,6 +1548,16 @@ def _finalize() -> dict:
             failures.setdefault(
                 name, f"cached result is from {entry.get('device_kind')} "
                       f"x{entry.get('n_chips')}, live is {hw[0]} x{hw[1]}")
+            continue
+        if (entry.get("batch_per_chip") is not None
+                and entry["batch_per_chip"] != configured_batch[name]):
+            # A phase whose primary batch config changed (e.g.
+            # resnet18_cifar_score 256 -> 512) must not have the OLD
+            # config's capture silently billed as the new primary.
+            failures.setdefault(
+                name, f"cached result is at batch "
+                      f"{entry['batch_per_chip']}/chip; the phase now "
+                      f"captures {configured_batch[name]}/chip")
             continue
         phases[name] = dict(entry, cached=True,
                             fresh_failure=failures.pop(
@@ -1403,6 +1684,10 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                          ("backend", "be")):
             if e.get(src) is not None:
                 c[dst] = e[src]
+        if isinstance(e.get("residency"), dict):
+            c["resid"] = e["residency"].get("mode")
+        if e.get("s2d"):
+            c["s2d"] = True
         phases[name] = c
     compact = {
         "metric": out.get("metric"), "value": out.get("value"),

@@ -12,7 +12,13 @@ as bench.py (untimed warmup, data-dependent host fetch),
      identical matmul/conv work minus the batch-stat reductions and
      their layer-serialized dependency chain);
   3. the forward pass alone under training BN semantics;
-  4. the scoring forward (eval BN) — bench.py's resnet50_imagenet_score.
+  4. the scoring forward (eval BN) — bench.py's resnet50_imagenet_score;
+  5. the two measured-ceiling responses, decomposed the same way:
+     fused bf16 BN statistics alone (train_full_bf16stats — the −23%
+     BN-stats cost reclaimed without touching the stem), the
+     space-to-depth stem alone (score_fwd_s2d), and the production
+     combination (train_full_s2d_bf16stats — bench.py's new
+     resnet50_imagenet_train configuration).
 
 Each timing is converted to achieved TFLOP/s with the phase's own
 XLA-reported flop count (cost_analysis via CPU lowering, the same
@@ -64,6 +70,17 @@ def measure(batch_per_chip: int, iters: int) -> dict:
     n_chips = int(mesh.devices.size)
     batch = batch_per_chip * n_chips
     model = resnet50(num_classes=1000, dtype=jnp.bfloat16)
+    # Variant models for the ceiling responses: fused bf16 BN statistics
+    # (same topology, different stats path) and the space-to-depth stem
+    # (exact conv refactoring — random init is fine for THROUGHPUT; the
+    # logits-equivalence question lives in tests/test_s2d_stem.py).
+    MODELS = {
+        "base": model,
+        "bnfused": resnet50(num_classes=1000, dtype=jnp.bfloat16,
+                            bn_stats_dtype=jnp.bfloat16),
+        "s2d": resnet50(num_classes=1000, dtype=jnp.bfloat16, stem="s2d",
+                        bn_stats_dtype=jnp.bfloat16),
+    }
     train_view = ViewSpec(IMAGENET_NORM, augment=True, pad=0)
     score_view = ViewSpec(IMAGENET_NORM, augment=False)
 
@@ -74,53 +91,61 @@ def measure(batch_per_chip: int, iters: int) -> dict:
         "mask": np.ones(batch, np.float32),
     }
     sharded = mesh_lib.shard_batch(host, mesh)
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.asarray(host["image"][:8]), train=False)
-    variables = mesh_lib.replicate(variables, mesh)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    VARS = {}
+    for vname, m in MODELS.items():
+        v = m.init(jax.random.PRNGKey(0), jnp.asarray(host["image"][:8]),
+                   train=False)
+        VARS[vname] = mesh_lib.replicate(v, mesh)
     # Same convention as the production optimizer (train/optim.py): the
     # transform returns RAW momentum-traced grads and the step applies
     # ``-lr`` itself — optax.sgd would already negate, and a second
-    # negation below would ascend the loss.
+    # negation below would ascend the loss.  Optimizer STATE is built
+    # per-variant inside build_train (a shared ResNet-50 momentum tree
+    # would pin ~100 MB of HBM across every timed variant).
     tx = optax.trace(decay=0.9)
-    opt_state = mesh_lib.replicate(tx.init(params), mesh)
     cw = jnp.ones(1000, jnp.float32)
 
-    def loss_fn(params, batch_stats, x, labels, weights, train_bn):
+    def loss_fn(params, batch_stats, x, labels, weights, train_bn,
+                variant):
+        m = MODELS[variant]
         v = {"params": params, "batch_stats": batch_stats}
         if train_bn:
-            logits, mut = model.apply(v, x, train=True,
-                                      mutable=["batch_stats"])
+            logits, mut = m.apply(v, x, train=True,
+                                  mutable=["batch_stats"])
             return (weighted_cross_entropy(logits, labels, weights),
                     mut["batch_stats"])
-        logits = model.apply(v, x, train=False)
+        logits = m.apply(v, x, train=False)
         return weighted_cross_entropy(logits, labels, weights), batch_stats
 
-    @functools.partial(jax.jit, static_argnames=("train_bn",),
+    @functools.partial(jax.jit, static_argnames=("train_bn", "variant"),
                        donate_argnums=(0, 1, 2))
-    def train_step(params, batch_stats, opt_state, key, batch, train_bn):
+    def train_step(params, batch_stats, opt_state, key, batch, train_bn,
+                   variant):
         x = apply_view(batch["image"], train_view, key=key, train=True)
         w = cw[batch["label"]] * batch["mask"]
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch_stats, x, batch["label"],
-                                   w, train_bn)
+                                   w, train_bn, variant)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(
             params, jax.tree.map(lambda u: -0.1 * u, updates))
         return params, new_stats, opt_state, loss
 
-    @functools.partial(jax.jit, static_argnames=("train_bn",))
-    def fwd_step(params, batch_stats, key, batch, carry, train_bn):
+    @functools.partial(jax.jit, static_argnames=("train_bn", "variant"))
+    def fwd_step(params, batch_stats, key, batch, carry, train_bn,
+                 variant):
         x = apply_view(batch["image"], train_view, key=key, train=True)
         loss, _ = loss_fn(params, batch_stats, x, batch["label"],
-                          cw[batch["label"]] * batch["mask"], train_bn)
+                          cw[batch["label"]] * batch["mask"], train_bn,
+                          variant)
         return carry + loss
 
-    score_step = scoring.make_prob_stats_step(model, score_view)
+    SCORE_STEPS = {vname: scoring.make_prob_stats_step(m, score_view)
+                   for vname, m in MODELS.items()}
 
-    @jax.jit
-    def score_chained(variables, batch, carry):
-        return carry + score_step(variables, batch)["margin"][0]
+    @functools.partial(jax.jit, static_argnames=("variant",))
+    def score_chained(variables, batch, carry, variant):
+        return carry + SCORE_STEPS[variant](variables, batch)["margin"][0]
 
     device_kind = jax.devices()[0].device_kind
     out = {"device_kind": device_kind, "n_chips": n_chips,
@@ -137,39 +162,45 @@ def measure(batch_per_chip: int, iters: int) -> dict:
                                 "ips_per_chip": round(ips / n_chips, 1)}
         print(f"[{name}] {ips / n_chips:,.0f} img/s/chip", file=sys.stderr)
 
-    def build_train(train_bn):
+    def build_train(train_bn, variant="base"):
         # Fresh device copies: train_step donates its state trees, and
         # both train variants (plus the fwd/score runs) must start from
         # live buffers — donating the shared originals would poison the
         # next build.
-        h = {"p": jax.tree.map(jnp.copy, params),
-             "bs": jax.tree.map(jnp.copy, batch_stats),
-             "o": jax.tree.map(jnp.copy, opt_state),
+        v = VARS[variant]
+        h = {"p": jax.tree.map(jnp.copy, v["params"]),
+             "bs": jax.tree.map(jnp.copy, v["batch_stats"]),
+             "o": mesh_lib.replicate(tx.init(
+                 jax.tree.map(np.asarray, v["params"])), mesh),
              "k": jax.random.PRNGKey(1), "loss": None}
 
         def once():
             h["k"], sub = jax.random.split(h["k"])
             h["p"], h["bs"], h["o"], h["loss"] = train_step(
-                h["p"], h["bs"], h["o"], sub, sharded, train_bn=train_bn)
+                h["p"], h["bs"], h["o"], sub, sharded, train_bn=train_bn,
+                variant=variant)
 
         return once, lambda: float(h["loss"])
 
-    def build_fwd(train_bn):
+    def build_fwd(train_bn, variant="base"):
+        v = VARS[variant]
         h = {"carry": jnp.float32(0.0), "k": jax.random.PRNGKey(2)}
 
         def once():
             h["k"], sub = jax.random.split(h["k"])
-            h["carry"] = fwd_step(params, batch_stats, sub, sharded,
-                                  h["carry"], train_bn=train_bn)
+            h["carry"] = fwd_step(v["params"], v["batch_stats"], sub,
+                                  sharded, h["carry"], train_bn=train_bn,
+                                  variant=variant)
 
         return once, lambda: float(h["carry"])
 
-    def build_score():
+    def build_score(variant="base"):
         sbatch = {"image": sharded["image"], "mask": sharded["mask"]}
         h = {"carry": jnp.float32(0.0)}
 
         def once():
-            h["carry"] = score_chained(variables, sbatch, h["carry"])
+            h["carry"] = score_chained(VARS[variant], sbatch, h["carry"],
+                                       variant=variant)
 
         return once, lambda: float(h["carry"])
 
@@ -178,6 +209,14 @@ def measure(batch_per_chip: int, iters: int) -> dict:
     run("fwd_only_frozen_bn", lambda: build_fwd(False))
     run("train_frozen_bn", lambda: build_train(False))
     run("train_full", lambda: build_train(True))
+    # The measured-ceiling responses, isolated then combined: bf16 BN
+    # statistics reclaim the stats tax with the stem untouched; the s2d
+    # stem re-shapes the 7x7/s2 conv for the MXU; the combination is the
+    # production bench configuration (bench.py resnet50_imagenet_train).
+    run("fwd_only_train_bn_bf16stats", lambda: build_fwd(True, "bnfused"))
+    run("train_full_bf16stats", lambda: build_train(True, "bnfused"))
+    run("score_fwd_s2d", lambda: build_score("s2d"))
+    run("train_full_s2d_bf16stats", lambda: build_train(True, "s2d"))
     return out
 
 
@@ -194,7 +233,18 @@ def main():
     # variants share the scoring conv/matmul structure plus the loss.
     GF = {"train_full": 23.91, "train_frozen_bn": 23.91,
           "fwd_only_train_bn": 7.97, "fwd_only_frozen_bn": 7.97,
-          "score_fwd_eval_bn": 7.97}
+          "score_fwd_eval_bn": 7.97,
+          # bf16 BN statistics change the stats path's memory traffic,
+          # not its flop count.
+          "fwd_only_train_bn_bf16stats": 7.97,
+          "train_full_bf16stats": 23.91,
+          # The s2d stem's folded 4x4x12 kernel carries 192 taps where
+          # the 7x7x3 had 147 (the pad row/col is structural zeros XLA
+          # still multiplies): +0.07 GF/img forward, +0.22 on the train
+          # step (analytic; MFU over these counts the zero taps as work,
+          # so the s2d MFU figures are conservative for useful flops).
+          "score_fwd_s2d": 8.04,
+          "train_full_s2d_bf16stats": 24.13}
     # Explicit device-kind match: a bare "v5" substring also matches v5p
     # (bf16 peak ~459 TFLOP/s), which would inflate reported MFU ~2.3x.
     # Unknown kinds leave mfu unset rather than guess a peak.

@@ -59,12 +59,12 @@ class TestResidentScoring:
         assert s._resident_pool is s.trainer.resident_pool
 
     def test_zero_budget_disables_resident_path(self):
-        """resident_scoring_bytes=0 must fall back to host-batched scoring
-        (no upload, host gathers happen)."""
-        import dataclasses
+        """A zero resident budget must fall back to host-batched scoring
+        (no upload, host gathers happen).  The budget is the trainer's
+        RESOLVED one (config None = auto-sized; an explicit 0 disables),
+        so the runtime seam is trainer.resident_budget."""
         s = make_strategy("MarginSampler", n_train=64)
-        s.train_cfg = dataclasses.replace(s.train_cfg,
-                                          resident_scoring_bytes=0)
+        s.trainer.resident_budget = 0
         calls = {"n": 0}
         orig = s.al_set.gather
 
