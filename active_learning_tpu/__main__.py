@@ -1,5 +1,7 @@
 """``python -m active_learning_tpu`` — the reference's ``python main_al.py``
-(README.md:53)."""
+(README.md:53).  One extra verb beyond the reference surface:
+``python -m active_learning_tpu serve ...`` starts the online scoring
+service over an experiment's best checkpoint (serve/cli.py)."""
 
 from .experiment.cli import main
 

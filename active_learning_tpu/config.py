@@ -185,6 +185,39 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """The online scoring service (active_learning_tpu/serve/): the
+    ``serve`` CLI verb's knobs.  Unlike every other config here this has
+    no reference counterpart — the reference has no serving path at all
+    (PARITY.md); request latency, not round wall-clock, is its metric.
+    """
+
+    host: str = "127.0.0.1"
+    # 0 = ephemeral (the bound port is logged and exposed on the server
+    # object) — tests and the bench phase run over loopback this way.
+    port: int = 8000
+    # Rows per dispatched device batch, upper bound.  Served shapes are
+    # the geometric bucket ladder serve_buckets(max_batch, bucket_floor)
+    # — every one pre-compiled at startup.
+    max_batch: int = 64
+    # Microbatch deadline: a batch closes at max_batch rows or this many
+    # ms after its first row, whichever comes first.
+    max_latency_ms: float = 5.0
+    # Admission bound in ROWS (queued + in flight); beyond it requests
+    # get 429 + Retry-After.  Explicit backpressure, never unbounded
+    # queueing.
+    queue_depth: int = 512
+    # Floor of the bucket ladder (pool.bucket_size floor): the smallest
+    # padded batch a lone request is served at.
+    bucket_floor: int = 8
+    # Hot-reload poll cadence for a newer best_rd_{n} checkpoint; 0
+    # checks before every batch.
+    reload_every_s: float = 5.0
+    # Bound on the SIGTERM graceful drain (in-flight completion).
+    drain_timeout_s: float = 30.0
+
+
+@dataclasses.dataclass(frozen=True)
 class ImbalanceConfig:
     """Synthetic class-imbalance parameters.
 
@@ -286,9 +319,9 @@ class ExperimentConfig:
     # Batched greedy k-center: provisionally-farthest picks folded into
     # the min-distance vector per pool pass, with an exact in-batch
     # re-check so the selection is pick-for-pick identical to q=1
-    # (strategies/kcenter.py).  8 = one center tile of the fused Pallas
-    # kernel; 1 restores the sequential scan.  Randomized (BADGE D^2)
-    # selection always draws one pick at a time regardless.
+    # (strategies/kcenter.py).  8 = the f32 sublane tile; 1 restores
+    # the sequential scan.  Randomized (BADGE D^2) selection always
+    # draws one pick at a time regardless.
     kcenter_batch: int = 8
 
     # Persistent XLA compilation-cache directory: round N+1 and run M+1

@@ -178,6 +178,17 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
 
 
 def main(argv: Optional[List[str]] = None):
+    import sys
+
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    # The one verb this CLI carries beyond the reference's flat flag
+    # surface: ``serve`` opens the ONLINE path (predictions +
+    # acquisition scores over HTTP from an experiment's best
+    # checkpoint — active_learning_tpu/serve/).  Flat invocations stay
+    # byte-compatible with every published reference command.
+    if argv and argv[0] == "serve":
+        from ..serve.cli import main as serve_main
+        return serve_main(argv[1:])
     from .driver import run_experiment
     args = get_parser().parse_args(argv)
     # run_experiment performs the jax.distributed rendezvous itself (a

@@ -1,11 +1,12 @@
 """Custom TPU kernels (Pallas).
 
-Only ops where measured XLA performance leaves headroom get a kernel —
-see DESIGN.md §5 for the decision record.  Current contents:
-
-  * kcenter_pallas — the k-center selection's fused batched
-    distance-update + block-local argmax (Q-center MXU matmul, min over
-    centers, running-min update and masked argmax in one VMEM-resident
-    pass over the transposed factor tiles); routed by the measured
-    dispatcher in strategies/kcenter.py.
+Currently EMPTY, on purpose.  Only ops where measured XLA performance
+leaves headroom get a kernel, and the one kernel that ever lived here —
+``kcenter_pallas``, the k-center selection's fused batched
+distance-update + block-local argmax — failed that bar on real
+hardware: the r5 on-MXU A/B measured 0.67x/1.11x/0.93x the XLA scan
+with ``pallas_picks_match: False`` in all three runs, so it was deleted
+per the r5 verdict rather than kept as an env-var-gated trap.  The full
+decision record (what the kernel fused, why XLA's matvec was already
+HBM-bound, and the bar any future kernel must clear) is DESIGN.md §5.
 """

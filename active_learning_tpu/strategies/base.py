@@ -80,6 +80,7 @@ class Strategy:
         self.mesh = trainer.mesh
         self.state: Optional[TrainState] = None
         self.best_epoch: int = 0
+        self.best_perf: float = 0.0
         # Device-resident pool cache: in-memory pool images live on device
         # for the WHOLE experiment (scoring.collect_pool fast path).  It
         # is the TRAINER'S cache, shared with evaluation, so one upload
@@ -222,6 +223,11 @@ class Strategy:
         self.resume_next_fit = False
         self.state = result.state
         self.best_epoch = result.best_epoch
+        # The fit's best validation accuracy: collapse detectors (e.g.
+        # the evidence protocol's re-init guard,
+        # scripts/cifar10_evidence.py) read it to tell a dead round —
+        # best-of-fit at chance — from a trained one.
+        self.best_perf = float(result.best_perf)
         self.logger.info(f"Finished training on round {self.round}")
 
     def test(self) -> Optional[float]:

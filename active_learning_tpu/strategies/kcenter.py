@@ -40,15 +40,13 @@ q=1 greedy (pinned in tests/test_kcenter.py).  When the re-check fails
 the step stops early; progress is still >= 1 pick (the first candidate is
 the unbatched argmax).
 
-**Dispatch.**  ``_select_backend`` routes between the XLA scans and the
-fused Pallas kernel (ops/kcenter_pallas.py) by measured block-size
-heuristics, not a flag: the r5 hardware A/B showed the per-pick matvec
-kernel at parity with XLA (0.67-1.11x), so Pallas is only chosen in the
-batched full-tile regime where the [q, TILE] MXU matmul plus the single
-fused update+argmax pass has real headroom; everywhere else the XLA scan
-answers and ``pallas_x >= 1.0`` holds by construction.
-``AL_TPU_KCENTER_PALLAS`` overrides: "1" forces the kernel, "0" forces
-XLA, "interpret" runs the kernel in interpret mode (CPU tests).
+**Backend.**  The XLA scans are the ONLY backend.  A fused Pallas
+kernel existed through r5 behind a measured dispatcher; the on-MXU A/B
+ran three times at 0.67x/1.11x/0.93x the XLA scan with
+``pallas_picks_match: False`` every time, so it was deleted per the r5
+verdict (wrong-on-hardware code behind an env var is a trap, not a
+feature).  The decision record survives in DESIGN.md §5;
+``LAST_BACKEND`` keeps the bench's backend attribution.
 
 Pool shapes are padded to bounded-waste geometric buckets
 (pool.bucket_size: 1/8-octave granularity — padded rows ride every
@@ -66,7 +64,6 @@ k-means++ D^2 weights, coreset_sampler.py:80-92).
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -76,17 +73,16 @@ import numpy as np
 from ..parallel import mesh as mesh_lib
 from ..pool import bucket_size
 
-try:  # pallas may be absent on exotic jax builds; the XLA scans never are
-    from ..ops import kcenter_pallas as kp
-except Exception:  # pragma: no cover - environment-dependent
-    kp = None
-
 Factors = Tuple[jnp.ndarray, ...]
 
-# Default q for the batched deterministic greedy: one CENTER_TILE of the
-# fused kernel (8 = the f32 sublane tile), the smallest batch that both
-# cuts scan steps ~8x and fills an MXU strip.  Overridden per experiment
-# via ExperimentConfig.kcenter_batch.
+# Which scan answered the last kcenter_greedy call ("xla" sequential /
+# "xla-batched"): bench.py's kcenter phases record it so a capture is
+# attributable to its code path.
+LAST_BACKEND: Optional[str] = None
+
+# Default q for the batched deterministic greedy: the f32 sublane tile
+# (8), the smallest batch that both cuts scan steps ~8x and fills an MXU
+# strip.  Overridden per experiment via ExperimentConfig.kcenter_batch.
 DEFAULT_BATCH_Q = 8
 
 # Pools are padded to the enclosing geometric bucket (>= this floor) so
@@ -231,9 +227,8 @@ def _recheck_candidates(cands: jnp.ndarray, vals: jnp.ndarray,
 
 def _accept_pick_batch(masked: jnp.ndarray, q: int, limit, sentinel: int,
                        pair_dists):
-    """One batched-greedy candidate round, shared verbatim by the XLA and
-    Pallas scan bodies so their pick semantics can never drift: masked
-    top-q, exact in-batch re-check, and the padded accepted sequence
+    """One batched-greedy candidate round, factored out of the scan body:
+    masked top-q, exact in-batch re-check, and the padded accepted sequence
     (unaccepted slots repeat the first pick — the min-fold is a no-op for
     duplicates and the next step overwrites their pick slots).
     ``pair_dists(cands) -> [q, q]`` supplies the candidate pairwise
@@ -285,69 +280,6 @@ def _kcenter_scan_batched(factors: Factors, sqn: jnp.ndarray,
     return picks[:budget]
 
 
-@functools.partial(jax.jit, static_argnames=("budget", "interpret"),
-                   donate_argnums=(2, 3))
-def _kcenter_scan_pallas(xt, sqn_row, min_row, sel_row, budget: int,
-                         interpret: bool) -> jnp.ndarray:
-    """q=1 deterministic scan on the fused Pallas kernel: each step folds
-    the previous pick into the min-distances AND finds the next pick in
-    the same pass over the pool tiles (ops/kcenter_pallas.py), so the
-    pool is read once per pick instead of twice.  Pick semantics match
-    _kcenter_scan exactly (argmax of the CURRENT min-distances)."""
-
-    idx0 = jnp.argmax(jnp.where(sel_row[0] > 0, min_row[0],
-                                -jnp.inf)).astype(jnp.int32)
-
-    def step(carry, _):
-        min_row, sel_row, idx = carry
-        sel_row = sel_row.at[0, idx].set(0.0)
-        centers = jnp.full((kp.CENTER_TILE,), idx, jnp.int32)
-        min_row, bmax, barg = kp.fused_update_argmax(
-            xt, sqn_row, min_row, sel_row, centers, interpret=interpret)
-        nxt = barg[0, jnp.argmax(bmax[0])]
-        return (min_row, sel_row, nxt), idx
-
-    _, picks = jax.lax.scan(step, (min_row, sel_row, idx0), None,
-                            length=budget)
-    return picks
-
-
-@functools.partial(jax.jit, static_argnames=("budget", "q", "interpret"),
-                   donate_argnums=(2, 3))
-def _kcenter_scan_batched_pallas(xt, sqn_row, min_row, sel_row, budget: int,
-                                 q: int, interpret: bool) -> jnp.ndarray:
-    """Batched greedy with the fused Pallas distance update: same
-    top-q + exact re-check as _kcenter_scan_batched, with the [N, q]
-    fold running as one kernel pass over the transposed pool tiles."""
-    n = sqn_row.shape[1]
-    picks0 = jnp.zeros(budget + q, jnp.int32)
-
-    def cond(st):
-        return st[3] < budget
-
-    def pair_dists(cands):
-        rows = jnp.take(xt, cands, axis=1).T  # xt columns are pool rows
-        sqn_c = sqn_row[0, cands]
-        return sqn_c[:, None] + sqn_c[None, :] - 2.0 * (rows @ rows.T)
-
-    def body(st):
-        min_row, sel_row, picks, count = st
-        masked = jnp.where(sel_row[0] > 0, min_row[0], -jnp.inf)
-        seq, n_acc = _accept_pick_batch(
-            masked, q, jnp.minimum(q, budget - count), n, pair_dists)
-        sel_row = sel_row.at[0, seq].set(0.0)
-        min_row, _, _ = kp.fused_update_argmax(
-            xt, sqn_row, min_row, sel_row,
-            kp.pad_centers(seq.astype(jnp.int32)), interpret=interpret)
-        picks = jax.lax.dynamic_update_slice(picks, seq.astype(jnp.int32),
-                                             (count,))
-        return (min_row, sel_row, picks, count + n_acc)
-
-    _, _, picks, _ = jax.lax.while_loop(
-        cond, body, (min_row, sel_row, picks0, jnp.int32(0)))
-    return picks[:budget]
-
-
 @functools.partial(jax.jit, static_argnames=("block",))
 def _minimax_row(factors: Factors, sqn: jnp.ndarray, block: int = 2048
                  ) -> jnp.ndarray:
@@ -366,36 +298,6 @@ def _minimax_row(factors: Factors, sqn: jnp.ndarray, block: int = 2048
     row_max, _ = jax.lax.scan(body, jnp.full((n,), -jnp.inf),
                               order.reshape(-1, block))
     return jnp.argmin(row_max)
-
-
-def _select_backend(n_pad: int, dim: int, n_factors: int, randomize: bool,
-                    q: int) -> str:
-    """Route between the XLA scans and the fused Pallas kernel.
-
-    The heuristic encodes the r5 hardware A/B (ops/kcenter_pallas.py
-    docstring): the kernel only wins when its MXU strips are FULL — a
-    CENTER_TILE of batched picks, at least one full TILE_D of features,
-    and enough TILE_N blocks that the parallel grid dimension amortizes
-    launch overhead.  Everything else takes the XLA scan, so a Pallas
-    choice is a claim the kernel should measure >= 1.0x
-    (bench.py asserts it).  AL_TPU_KCENTER_PALLAS: "1" force-on, "0"
-    force-off, "interpret" force-on in interpret mode (CPU tests),
-    unset/"" = this heuristic.
-    """
-    if kp is None or n_factors != 1 or randomize:
-        return "xla"
-    mode = os.environ.get("AL_TPU_KCENTER_PALLAS", "")
-    if mode == "0":
-        return "xla"
-    if mode == "interpret":
-        return "pallas-interpret"
-    if mode == "1":
-        return "pallas"
-    if jax.default_backend() != "tpu":
-        return "xla"
-    if q < kp.CENTER_TILE or dim < kp.TILE_D or n_pad < 8 * kp.TILE_N:
-        return "xla"
-    return "pallas"
 
 
 def kcenter_greedy(
@@ -465,74 +367,32 @@ def kcenter_greedy(
     selectable[:n] = 1.0
     selectable[labeled_idxs] = 0.0
 
-    backend = _select_backend(n_pad, factors[0].shape[1], len(factors),
-                              randomize, q)
-    if kp is not None:
-        kp.LAST_BACKEND = backend
-        kp.LAST_FALLBACK_ERROR = None
-    picks = None
-    if backend.startswith("pallas"):
-        interpret = backend == "pallas-interpret"
-        try:
-            xt = kp.pad_to_tiles(factors[0])
-            n_tile = xt.shape[1]
-            sqn_row = jnp.zeros((1, n_tile), jnp.float32).at[0, :n_pad].set(
-                sqn)
-            md_row = jnp.full((1, n_tile), jnp.inf,
-                              jnp.float32).at[0, :n_pad].set(min_dist)
-            sel_row = jnp.zeros((1, n_tile), jnp.float32).at[0, :n_pad].set(
-                jnp.asarray(selectable))
-            if q > 1:
-                picks = np.asarray(
-                    _kcenter_scan_batched_pallas(xt, sqn_row, md_row,
-                                                 sel_row, budget, q,
-                                                 interpret),
-                    dtype=np.int64)
-            else:
-                picks = np.asarray(
-                    _kcenter_scan_pallas(xt, sqn_row, md_row, sel_row,
-                                         budget, interpret),
-                    dtype=np.int64)
-        except Exception as e:
-            # A compiled-kernel failure on real hardware (tiling limits,
-            # pltpu API drift) must degrade to the XLA scan, not kill the
-            # experiment mid-round.  In interpret mode (CI) the opposite:
-            # a silent fallback would make the pick-equality pin test
-            # compare XLA to XLA and pass vacuously — re-raise there.
-            if interpret:
-                raise
-            kp.LAST_BACKEND = "xla"
-            kp.LAST_FALLBACK_ERROR = repr(e)  # bench A/B reads this
-            from ..utils.logging import get_logger
-            get_logger().warning(
-                f"Pallas k-center update failed ({e!r}); falling back to "
-                "the XLA scan")
-    if picks is None:
-        if (mesh is not None and mesh.devices.size > 1
-                and not mesh_lib.is_multiprocess(mesh)
-                and n_pad % mesh.devices.size == 0):
-            # Shard the pool axis over the mesh: the per-step [N, q]
-            # distance pass, strip min, and running-min update all run
-            # shard-local; the top-k / argmax is the step's one
-            # cross-shard reduction.  Exact — min/max reductions do no
-            # rounding and each row's matvec stays on one shard.
-            sh = mesh_lib.batch_sharding(mesh)
-            factors = tuple(jax.device_put(f, sh) for f in factors)
-            sqn = jax.device_put(sqn, sh)
-            min_dist = jax.device_put(min_dist, sh)
-            sel_dev = jax.device_put(jnp.asarray(selectable), sh)
-        else:
-            sel_dev = jnp.asarray(selectable)
-        if q > 1:
-            picks = np.asarray(
-                _kcenter_scan_batched(factors, sqn, min_dist, sel_dev,
-                                      budget, q), dtype=np.int64)
-            if kp is not None and kp.LAST_BACKEND == "xla":
-                kp.LAST_BACKEND = "xla-batched"
-        else:
-            picks = np.asarray(
-                _kcenter_scan(factors, sqn, min_dist, sel_dev, budget,
-                              bool(randomize), key), dtype=np.int64)
+    global LAST_BACKEND
+    if (mesh is not None and mesh.devices.size > 1
+            and not mesh_lib.is_multiprocess(mesh)
+            and n_pad % mesh.devices.size == 0):
+        # Shard the pool axis over the mesh: the per-step [N, q]
+        # distance pass, strip min, and running-min update all run
+        # shard-local; the top-k / argmax is the step's one
+        # cross-shard reduction.  Exact — min/max reductions do no
+        # rounding and each row's matvec stays on one shard.
+        sh = mesh_lib.batch_sharding(mesh)
+        factors = tuple(jax.device_put(f, sh) for f in factors)
+        sqn = jax.device_put(sqn, sh)
+        min_dist = jax.device_put(min_dist, sh)
+        sel_dev = jax.device_put(jnp.asarray(selectable), sh)
+    else:
+        sel_dev = jnp.asarray(selectable)
+    if q > 1:
+        picks = np.asarray(
+            _kcenter_scan_batched(factors, sqn, min_dist, sel_dev,
+                                  budget, q), dtype=np.int64)
+        LAST_BACKEND = "xla-batched"
+    else:
+        picks = np.asarray(
+            _kcenter_scan(factors, sqn, min_dist, sel_dev, budget,
+                          bool(randomize), key), dtype=np.int64)
+        LAST_BACKEND = "xla"
     return np.concatenate([np.asarray(picks_pre, dtype=np.int64), picks])
 
 

@@ -59,18 +59,29 @@ def batched_min_dist_update(factors, sqn: jnp.ndarray,
 def make_prob_stats_step(model, view: ViewSpec) -> Callable:
     """Per-example softmax statistics in one fused pass: top-1 probability
     (ConfidenceSampler's score, confidence_sampler.py:33-36), top1-top2
-    probability margin (MarginSampler's score, margin_sampler.py:33-35) and
-    the predicted label."""
+    probability margin (MarginSampler's score, margin_sampler.py:33-35),
+    the predictive entropy (served by /v1/score — no reference sampler
+    uses it, but it rides the same softmax for free), and the predicted
+    label.  This step is shared verbatim by the offline samplers and the
+    scoring service (serve/executor.py), which is what makes a served
+    score bit-for-bit the offline score at the same batch shape."""
 
     @jax.jit
     def step(variables, batch):
         x = apply_view(batch["image"], view, train=False)
         logits = model.apply(variables, x, train=False)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        logits32 = logits.astype(jnp.float32)
+        probs = jax.nn.softmax(logits32, axis=-1)
+        logp = jax.nn.log_softmax(logits32, axis=-1)
         top2, top2_idx = jax.lax.top_k(probs, 2)
         return {
             "confidence": top2[:, 0],
             "margin": top2[:, 0] - top2[:, 1],
+            # -sum p log p via log_softmax; a prob that underflowed to
+            # exactly 0 would make 0 * -inf = NaN, so those entries are
+            # pinned to the limit value 0.
+            "entropy": -jnp.sum(jnp.where(probs > 0, probs * logp, 0.0),
+                                axis=-1),
             "pred": top2_idx[:, 0].astype(jnp.int32),
         }
 

@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional
+import re
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -71,6 +72,30 @@ def weight_paths(ckpt_root: str, exp_name: str, exp_hash: str,
         "fit_state": os.path.join(ckpt_dir, f"fit_state_rd_{round_idx}"),
         "dir": ckpt_dir,
     }
+
+
+def latest_best_ckpt(ckpt_dir: str) -> Tuple[Optional[str], int]:
+    """(path, round) of the newest round's ``best_rd_{n}.msgpack`` under
+    ``ckpt_dir``, or (None, -1) when none exists.
+
+    The scoring service's hot-reload probe (serve/executor.py): a
+    running AL experiment appends best checkpoints round by round, and
+    the service polls this between batches to serve the freshest model
+    without a restart.  Writes are atomic (save_variables), so whatever
+    this returns is always a complete file."""
+    best: Tuple[Optional[str], int] = (None, -1)
+    try:
+        names = os.listdir(ckpt_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        return best
+    for name in names:
+        m = _BEST_CKPT_RE.match(name)
+        if m and int(m.group(1)) > best[1]:
+            best = (os.path.join(ckpt_dir, name), int(m.group(1)))
+    return best
+
+
+_BEST_CKPT_RE = re.compile(r"^best_rd_(\d+)\.msgpack$")
 
 
 # -- mid-round fit state ----------------------------------------------------

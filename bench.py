@@ -12,10 +12,13 @@ Eight phases, bfloat16 over the full local mesh:
     decoder into the mesh scoring pass (per-core decode rate, h2d
     bandwidth, end-to-end images/sec).
   * kcenter_select — greedy selection at protocol scale (10k picks over a
-    [50k, 2048] pool) through the production batched-greedy path with
-    auto Pallas/XLA dispatch, plus a forced-backend A/B that asserts the
-    dispatcher's choice (pallas_x >= 1.0 whenever Pallas was chosen —
-    a violation is recorded as pallas_regression).
+    [50k, 2048] pool) through the production batched-greedy XLA scan
+    (the Pallas kernel was deleted per the r5 verdict — DESIGN.md §5).
+  * serve_throughput — the ONLINE path: a loopback scoring service
+    (active_learning_tpu/serve/) under the closed+open-loop load
+    generator, recording qps, p50/p99 request latency, the
+    batch-occupancy histogram, and asserting zero request-path XLA
+    compiles after the bucket warmup.
   * al_round_cifar / al_round_imagenet — BASELINE.md metric #1: one REAL
     end-to-end AL round (query -> train -> test) through the production
     driver (experiment/driver.py), with the per-phase wall-clock the
@@ -129,7 +132,8 @@ PHASES = [
     # The selection hot loop (SURVEY hard part (a)): greedy k-center over
     # a 50k-row, 2048-dim pool — the reference's paper protocol subsets
     # the pool to 50k and picks 10k per round (gen_jobs.py:8-13).  iters
-    # is the budget (picks); per-chip batch is unused.
+    # is the budget (picks); per-chip batch is unused.  XLA scan only
+    # since the r5 verdict deleted the Pallas kernel.
     ("kcenter_select", 10000, 128, 600),
     # The same selection at the PAPER'S pool size: the protocol scores a
     # 130k subset (50k labeled cap + 80k unlabeled cap, gen_jobs.py:8-13)
@@ -148,6 +152,13 @@ PHASES = [
     # VAALSampler step, with finite-loss/learning assertions.  iters is
     # the epoch count.
     ("vaal_cotrain", 1, 64, 600),
+    # The ONLINE path (active_learning_tpu/serve/): a loopback scoring
+    # service driven by the closed+open-loop load generator.  iters is
+    # the closed-loop window in SECONDS; per-chip batch is the service's
+    # max_batch.  Records qps, p50/p99 request latency, the
+    # batch-occupancy histogram, and asserts ZERO request-path compiles
+    # after the bucket warmup (the test_compile_reuse counter).
+    ("serve_throughput", 8, 64, 600),
     # BASELINE.md metric #1: real end-to-end AL rounds through the
     # production driver.  iters is the per-round epoch count.
     ("al_round_cifar", 4, 128, 900),
@@ -511,23 +522,17 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
     gen_jobs.py:8-13; its host loop does one np.random.choice +
     full-matrix min per pick, coreset_sampler.py:66-105).  Times the
     PRODUCTION path: batched farthest-first (q = DEFAULT_BATCH_Q picks
-    per pool pass) with the dispatcher auto-selecting Pallas vs the XLA
-    scan (strategies/kcenter.py); the chosen backend is recorded so a
-    fallback is attributable.  Reports picks/sec; "ips" carries
-    picks/sec so the parent's schema checks hold (unit field says
-    which)."""
+    per pool pass) on the XLA scan — since the r5 verdict deleted the
+    Pallas kernel this is the only backend; the scan that answered
+    still rides in "backend" for attribution.  Reports picks/sec; "ips"
+    carries picks/sec so the parent's schema checks hold (unit field
+    says which)."""
     import numpy as np
 
     import jax
+    from active_learning_tpu.strategies import kcenter as kc
     from active_learning_tpu.strategies.kcenter import (DEFAULT_BATCH_Q,
                                                         kcenter_greedy)
-    try:
-        # Same guard as strategies/kcenter.py: on jax builds without a
-        # usable pallas the XLA selection path still works and must
-        # still be timed — only the backend attribution goes missing.
-        from active_learning_tpu.ops import kcenter_pallas as kp
-    except Exception:
-        kp = None
 
     device_kind = jax.devices()[0].device_kind
     log(f"[kcenter_select] pool [{pool_n}, {dim}], budget {budget} on "
@@ -540,7 +545,6 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
 
     # Warm-up at the SAME budget/shapes (budget is a static scan length):
     # the first call pays the XLA compile, the timed call does not.
-    os.environ.pop("AL_TPU_KCENTER_PALLAS", None)
     kcenter_greedy((emb,), labeled, budget, rng=np.random.default_rng(1))
     t0 = time.perf_counter()
     picks = kcenter_greedy((emb,), labeled, budget,
@@ -558,7 +562,7 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
         "dim": dim,
         "budget": budget,
         "batch_q": DEFAULT_BATCH_Q,
-        "backend": getattr(kp, "LAST_BACKEND", None) if kp else "xla",
+        "backend": kc.LAST_BACKEND,
         "select_sec": round(dt, 2),
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
@@ -571,90 +575,6 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
     except Exception:
         pass  # memory_stats is backend-dependent; absence is fine
     return result, picks
-
-
-def run_kcenter_pallas_ab(budget: int, auto_result: dict,
-                          auto_picks, dim: int = 2048,
-                          pool_n: int = 50000):
-    """A/B the fused Pallas kernel against the XLA scan around the
-    dispatcher's auto choice (strategies/kcenter.py:_select_backend).
-
-    The phase just timed the PRODUCTION path; this measures the road not
-    taken — forced XLA when auto chose Pallas, forced Pallas when auto
-    fell back — so ``pallas_speedup`` (the compact line's ``pallas_x``)
-    is always auto-relative.  The contract asserted here: when the
-    dispatcher chose Pallas, pallas_x >= 1.0 MUST hold; a violation is
-    recorded as ``pallas_regression`` (the heuristic claimed a win the
-    hardware disproved) so the next bench round fails loudly.  A
-    fallback choice is legitimate by construction and pallas_x < 1.0
-    there just documents why.  Pick equality between the two backends is
-    reported too (MXU accumulation order differs; an argmax tie could
-    flip a pick — interpret-mode tests cannot see this).  TPU only;
-    failures are recorded, never fatal — the production number is
-    already with the parent."""
-    import numpy as np
-
-    import jax
-    from active_learning_tpu.strategies.kcenter import kcenter_greedy
-
-    if jax.devices()[0].platform != "tpu":
-        return None
-    host_rng = np.random.default_rng(0)
-    emb = host_rng.normal(size=(pool_n, dim)).astype(np.float32)
-    labeled = np.zeros(pool_n, dtype=bool)
-    labeled[host_rng.choice(pool_n, min(1000, pool_n // 8),
-                            replace=False)] = True
-    result = dict(auto_result)
-    auto_backend = str(auto_result.get("backend") or "")
-    auto_was_pallas = auto_backend.startswith("pallas")
-    # Measure the opposite backend from the dispatcher's auto pick.
-    os.environ["AL_TPU_KCENTER_PALLAS"] = "0" if auto_was_pallas else "1"
-    try:
-        # Inside the try: if the kernel MODULE itself fails to import,
-        # that is a pallas_error record, not a child crash.
-        from active_learning_tpu.ops import kcenter_pallas as kp
-        kp.LAST_FALLBACK_ERROR = None
-        kcenter_greedy((emb,), labeled, budget,
-                       rng=np.random.default_rng(1))  # compile
-        t0 = time.perf_counter()
-        picks = kcenter_greedy((emb,), labeled, budget,
-                               rng=np.random.default_rng(2))
-        dt = time.perf_counter() - t0
-        if kp.LAST_FALLBACK_ERROR is not None:
-            # The XLA fallback answered a forced-Pallas run: there IS no
-            # Pallas measurement, and recording one would fake a working
-            # kernel.
-            raise RuntimeError(
-                f"kernel fell back to XLA: {kp.LAST_FALLBACK_ERROR}")
-        assert len(set(picks.tolist())) == budget
-        other_ips = budget / dt
-        if auto_was_pallas:
-            pallas_ips, xla_ips = float(result["ips"]), other_ips
-        else:
-            pallas_ips, xla_ips = other_ips, float(result["ips"])
-        result["pallas_ips"] = round(pallas_ips, 1)
-        result["xla_ips"] = round(xla_ips, 1)
-        result["pallas_select_sec"] = round(
-            budget / max(pallas_ips, 1e-9), 2)
-        result["pallas_speedup"] = round(pallas_ips / max(xla_ips, 1e-9), 2)
-        result["pallas_picks_match"] = bool(np.array_equal(picks,
-                                                           auto_picks))
-        if auto_was_pallas and result["pallas_speedup"] < 1.0:
-            # The dispatcher chose the kernel and lost the A/B: the
-            # heuristic must be tightened until it falls back here.
-            result["pallas_regression"] = True
-            log(f"[kcenter_select] REGRESSION: dispatcher chose pallas at "
-                f"{result['pallas_speedup']}x < 1.0 — the heuristic must "
-                "fall back for this shape")
-        log(f"[kcenter_select] pallas {pallas_ips:,.0f} vs xla "
-            f"{xla_ips:,.0f} picks/s ({result['pallas_speedup']}x, auto="
-            f"{auto_backend}), picks_match={result['pallas_picks_match']}")
-    except Exception as e:
-        log(f"[kcenter_select] pallas A/B failed: {e!r}")
-        result["pallas_error"] = repr(e)[:200]
-    finally:
-        os.environ.pop("AL_TPU_KCENTER_PALLAS", None)
-    return result
 
 
 def run_kcenter_maxn_phase(budget: int, dim: int = 2048):
@@ -852,6 +772,129 @@ def run_vaal_phase(epochs: int, per_chip: int):
         "d_loss_last": round(d_l[-1], 4),
         "finite_losses": True,
         "learned": bool(learned),
+        "device_kind": device_kind,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def run_serve_phase(duration_s: int, max_batch: int) -> dict:
+    """The ONLINE path's throughput/latency record: a real loopback
+    scoring service (active_learning_tpu/serve/ — asyncio HTTP server,
+    microbatcher, device executor) driven by the closed+open-loop load
+    generator (scripts/serve_loadgen.py).  Request latency, not round
+    wall-clock, is the metric here; "ips" carries served images/sec so
+    the parent's schema checks hold (the unit field says which).
+
+    The phase also asserts the serving contract the subsystem was built
+    around: after the startup bucket warmup, the request path performs
+    ZERO XLA compiles (the tests/test_compile_reuse.py counter, read
+    back through /metrics) — a violation fails the phase loudly.
+
+    AL_BENCH_SERVE_SMOKE=1 shrinks to a tiny linear model at 8px for
+    CI; the production capture serves SSLResNet18 at the CIFAR shape in
+    bf16, the same model resnet18_cifar_score measures offline."""
+    import asyncio
+    import importlib.util
+    import threading
+
+    import numpy as np
+
+    import jax
+    from active_learning_tpu.config import ServeConfig
+    from active_learning_tpu.parallel import mesh as mesh_lib
+    from active_learning_tpu.serve.executor import DeviceExecutor
+    from active_learning_tpu.serve.server import ScoringServer
+
+    smoke = os.environ.get("AL_BENCH_SERVE_SMOKE") == "1"
+    n_chips = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
+    if smoke:
+        import flax.linen as nn
+        import jax.numpy as jnp
+        from active_learning_tpu.data.core import CIFAR10_NORM, ViewSpec
+
+        class _Probe(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=True, return_features=False):
+                emb = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+                logits = nn.Dense(10, name="linear")(emb)
+                return (logits, emb) if return_features else logits
+
+        model, px = _Probe(), 8
+        score_view = ViewSpec(CIFAR10_NORM, augment=False)
+        duration_s = min(int(duration_s), 3)
+        max_batch = min(int(max_batch), 16)
+        workers, rows = 2, 4
+    else:
+        model, px, _n_classes, _tv, score_view = _model_and_views(
+            "resnet18_cifar")
+        workers, rows = 4, max(1, max_batch // 4)
+    mesh = mesh_lib.make_mesh(-1)
+    variables = jax.tree.map(np.asarray, model.init(
+        jax.random.PRNGKey(0), np.zeros((2, px, px, 3), np.float32),
+        train=False))
+    executor = DeviceExecutor(model, score_view, mesh,
+                              image_shape=(px, px, 3),
+                              variables=variables)
+    serve_cfg = ServeConfig(host="127.0.0.1", port=0, max_batch=max_batch,
+                            max_latency_ms=5.0,
+                            queue_depth=max(128, 8 * max_batch))
+    server = ScoringServer(executor, serve_cfg)
+    log(f"[serve_throughput] {n_chips}x {device_kind}, max_batch "
+        f"{max_batch}, {duration_s}s closed window, {workers} workers x "
+        f"{rows} rows")
+
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(
+        target=lambda: (asyncio.set_event_loop(loop), loop.run_forever()),
+        daemon=True, name="al-bench-serve-loop")
+    thread.start()
+    spec = importlib.util.spec_from_file_location(
+        "serve_loadgen", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts", "serve_loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+    try:
+        asyncio.run_coroutine_threadsafe(server.start(), loop).result(600)
+        url = f"http://127.0.0.1:{server.port}"
+        shape = (px, px, 3)
+        closed = loadgen.run_closed(url, duration_s, workers, rows, shape)
+        open_qps = max(1.0, 0.7 * closed["qps"])
+        opened = loadgen.run_open(url, max(1.0, duration_s / 2),
+                                  open_qps, rows, shape)
+        snap = server._metrics()
+    finally:
+        try:
+            asyncio.run_coroutine_threadsafe(server.drain(), loop).result(60)
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+            thread.join(timeout=10)
+    compiles = snap["compiles"]["request_path_compiles"]
+    # THE contract: every served shape was pre-compiled at startup.
+    assert compiles == 0, (
+        f"request path compiled {compiles}x after warmup — a served "
+        "shape escaped the bucket ladder")
+    return {
+        "phase": "serve_throughput",
+        "ips": closed["ips"],
+        "ips_per_chip": round(closed["ips"] / n_chips, 1),
+        "unit": "scored images/sec (served)",
+        "n_chips": n_chips,
+        "batch_per_chip": max_batch,
+        "qps_closed": closed["qps"],
+        "p50_ms_closed": closed["p50_ms"],
+        "p99_ms_closed": closed["p99_ms"],
+        "qps_open_offered": opened.get("offered_qps"),
+        "qps_open": opened["qps"],
+        "p50_ms_open": opened["p50_ms"],
+        "p99_ms_open": opened["p99_ms"],
+        "n_429": closed["n_429"] + opened["n_429"],
+        "workers": workers,
+        "rows_per_request": rows,
+        "batch_occupancy": snap["batch_occupancy"],
+        "request_path_compiles": compiles,
+        "buckets": list(server.batcher.buckets),
+        "smoke": smoke,
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
     }
@@ -1191,11 +1234,8 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         yield run_al_round_phase(phase[len("al_round_"):], iters)
         return
     if phase == "kcenter_select":
-        result, xla_picks = run_kcenter_phase(iters)
-        yield dict(result)  # the XLA measurement is safe with the parent
-        extra = run_kcenter_pallas_ab(iters, result, xla_picks)
-        if extra is not None:
-            yield extra
+        result, _picks = run_kcenter_phase(iters)
+        yield result
         return
     if phase == "kcenter_select_130k":
         # Paper scale, production path (batched greedy + auto dispatch —
@@ -1210,6 +1250,9 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         return
     if phase == "vaal_cotrain":
         yield run_vaal_phase(iters, per_chip)
+        return
+    if phase == "serve_throughput":
+        yield run_serve_phase(iters, per_chip)
         return
     config, kind = phase.rsplit("_", 1)
     n_chips = len(jax.devices())
@@ -1672,15 +1715,16 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
             c["unit"] = e["unit"]
         if e.get("cached"):
             c["cached"] = True
-        # The warm-round / warm-cache / Pallas numbers are round-5
-        # headline evidence (VERDICT Weak #5/#7) — small enough to ride.
+        # The warm-round / warm-cache / backend / serving numbers are
+        # round-level headline evidence — small enough to ride the line.
         for src, dst in (("ips_warm", "warm_ips"),
                          ("round_sec_warm", "warm_s"),
                          ("round_sec_cold", "cold_s"),
                          ("compile_tax_sec", "tax_s"),
                          ("test_accuracy_rd1", "acc"),
-                         ("pallas_speedup", "pallas_x"),
-                         ("pallas_regression", "pallas_regression"),
+                         ("qps_closed", "qps"),
+                         ("p99_ms_closed", "p99_ms"),
+                         ("request_path_compiles", "req_compiles"),
                          ("backend", "be")):
             if e.get(src) is not None:
                 c[dst] = e[src]

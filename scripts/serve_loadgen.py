@@ -1,0 +1,251 @@
+"""Closed- and open-loop load generator for the scoring service.
+
+    python scripts/serve_loadgen.py --url http://127.0.0.1:8000 \\
+        [--mode closed|open|both] [--duration 10] [--workers 4] \\
+        [--rows 8] [--qps 200] [--endpoint /v1/score]
+
+Two loop disciplines, because they answer different questions:
+
+  * **closed** — N workers fire back-to-back requests (a new request
+    the moment the previous response lands).  Measures the service's
+    throughput ceiling; latency under closed load is a function of the
+    worker count, not of the service alone.
+  * **open** — requests fire on a fixed schedule at ``--qps``
+    regardless of responses (the Poisson-ish arrival pattern real
+    traffic has).  Measures latency at a given offered load and how
+    the 429 backpressure behaves past saturation; a closed loop can
+    never see those, because it slows itself down.
+
+Payloads are random uint8 images shaped from the server's own
+``/healthz`` (``image_shape``), sent as ``{"b64", "shape"}`` — the
+efficient wire path.  Output: ONE JSON line per mode with achieved
+qps/ips, p50/p99 latency (nearest-rank, the server's convention), and
+status counts.  Stdlib only; keep-alive via one http.client connection
+per worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import concurrent.futures
+import http.client
+import json
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    # Same nearest-rank convention as serve/metrics.py.
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[idx])
+
+
+def fetch_health(url: str, timeout: float = 10.0) -> Dict:
+    with urllib.request.urlopen(f"{url}/healthz", timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def make_payload(image_shape, rows: int, seed: int = 0) -> bytes:
+    h, w, c = image_shape
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, size=(rows, h, w, c), dtype=np.uint8)
+    return json.dumps({
+        "b64": base64.b64encode(images.tobytes()).decode(),
+        "shape": [rows, h, w, c],
+    }).encode()
+
+
+class _Worker:
+    """One keep-alive connection; returns (status, latency_s) per post."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        p = urllib.parse.urlparse(url)
+        self._host, self._port = p.hostname, p.port or 80
+        self._timeout = timeout
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def post(self, path: str, body: bytes):
+        t0 = time.perf_counter()
+        for attempt in (0, 1):  # one reconnect on a dropped keep-alive
+            if self._conn is None:
+                self._conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self._timeout)
+            try:
+                self._conn.request(
+                    "POST", path, body=body,
+                    headers={"Content-Type": "application/json"})
+                resp = self._conn.getresponse()
+                resp.read()
+                if resp.getheader("Connection", "").lower() == "close":
+                    self._conn.close()
+                    self._conn = None
+                return resp.status, time.perf_counter() - t0
+            except (http.client.HTTPException, OSError):
+                self._conn = None
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+
+def _summarize(mode: str, statuses: List[int], lats: List[float],
+               wall: float, rows_per_req: int, offered_qps=None) -> Dict:
+    lats = sorted(lats)
+    n_ok = sum(1 for s in statuses if s == 200)
+    out = {
+        "mode": mode,
+        "wall_s": round(wall, 2),
+        "n_requests": len(statuses),
+        "n_ok": n_ok,
+        "n_429": sum(1 for s in statuses if s == 429),
+        "n_err": sum(1 for s in statuses if s not in (200, 429)),
+        "rows_per_request": rows_per_req,
+        "qps": round(n_ok / wall, 2) if wall > 0 else 0.0,
+        "ips": round(n_ok * rows_per_req / wall, 1) if wall > 0 else 0.0,
+        "p50_ms": _ms(_percentile(lats, 0.50)),
+        "p99_ms": _ms(_percentile(lats, 0.99)),
+    }
+    if offered_qps is not None:
+        out["offered_qps"] = offered_qps
+    return out
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v * 1000.0, 3)
+
+
+def run_closed(url: str, duration_s: float, workers: int, rows: int,
+               image_shape, endpoint: str = "/v1/score",
+               warmup_requests: int = 2) -> Dict:
+    """Closed loop: ``workers`` threads, back-to-back requests."""
+    body = make_payload(image_shape, rows)
+    # inf until the window opens: a worker racing past the barrier ahead
+    # of the main thread's deadline write must keep looping, not exit.
+    stop_at = [float("inf")]
+    # Workers warm their connection + the service's first batches OFF
+    # the clock, rendezvous at the barrier, and only then does the main
+    # thread open the measurement window.
+    barrier = threading.Barrier(workers + 1)
+    lock = threading.Lock()
+    statuses: List[int] = []
+    lats: List[float] = []
+
+    def loop(seed: int):
+        w = _Worker(url)
+        for _ in range(warmup_requests):  # connection + first-batch warm
+            w.post(endpoint, body)
+        barrier.wait()
+        local_s, local_l = [], []
+        while time.perf_counter() < stop_at[0]:
+            s, dt = w.post(endpoint, body)
+            local_s.append(s)
+            local_l.append(dt)
+        with lock:
+            statuses.extend(local_s)
+            lats.extend(local_l)
+
+    threads = [threading.Thread(target=loop, args=(i,), daemon=True)
+               for i in range(workers)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    stop_at[0] = t0 + duration_s
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    out = _summarize("closed", statuses, lats, wall, rows)
+    out["workers"] = workers
+    return out
+
+
+def run_open(url: str, duration_s: float, qps: float, rows: int,
+             image_shape, endpoint: str = "/v1/score",
+             max_inflight: int = 256) -> Dict:
+    """Open loop: fire at ``qps`` on schedule, independent of responses.
+    Requests the schedule could not launch (pool exhausted) count as
+    errors — offered load is part of the measurement."""
+    body = make_payload(image_shape, rows)
+    lock = threading.Lock()
+    statuses: List[int] = []
+    lats: List[float] = []
+    local = threading.local()
+
+    def one():
+        w = getattr(local, "w", None)
+        if w is None:
+            w = local.w = _Worker(url)
+        try:
+            s, dt = w.post(endpoint, body)
+        except OSError:
+            s, dt = -1, None
+        with lock:
+            statuses.append(s)
+            if dt is not None and s == 200:
+                lats.append(dt)
+
+    n = max(1, int(duration_s * qps))
+    interval = 1.0 / qps
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(max_inflight) as pool:
+        futures = []
+        for i in range(n):
+            target = t0 + i * interval
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futures.append(pool.submit(one))
+        for f in futures:
+            f.result()
+    wall = time.perf_counter() - t0
+    return _summarize("open", statuses, lats, wall, rows, offered_qps=qps)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", default="http://127.0.0.1:8000")
+    ap.add_argument("--mode", default="both",
+                    choices=["closed", "open", "both"])
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--workers", type=int, default=4,
+                    help="closed-loop concurrency")
+    ap.add_argument("--rows", type=int, default=8,
+                    help="images per request")
+    ap.add_argument("--qps", type=float, default=None,
+                    help="open-loop offered load (default: 70%% of the "
+                         "closed loop's measured qps)")
+    ap.add_argument("--endpoint", default="/v1/score",
+                    choices=["/v1/score", "/v1/predict"])
+    args = ap.parse_args(argv)
+
+    health = fetch_health(args.url)
+    shape = health["image_shape"]
+    results = []
+    if args.mode in ("closed", "both"):
+        results.append(run_closed(args.url, args.duration, args.workers,
+                                  args.rows, shape, args.endpoint))
+        print(json.dumps(results[-1]), flush=True)
+    if args.mode in ("open", "both"):
+        qps = args.qps
+        if qps is None:
+            # Probe at 70% of the measured ceiling: open-loop latency is
+            # only meaningful below saturation.
+            base = results[0]["qps"] if results else 20.0
+            qps = max(1.0, 0.7 * base)
+        results.append(run_open(args.url, args.duration, qps, args.rows,
+                                shape, args.endpoint))
+        print(json.dumps(results[-1]), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

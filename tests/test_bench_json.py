@@ -59,6 +59,10 @@ class TestDegradedModeLine:
         # phase shows up as an explicit failure, never silently absent.
         assert out["value"] is None
         assert out.get("failed")
+        # The serving phase rides the same guarantee: with no live
+        # backend it appears as an explicit failure on the degraded
+        # line, exactly like every offline phase.
+        assert "serve_throughput" in out["failed"]
         # The full evidence file landed in the REDIRECTED dir and is
         # itself strict-parseable.
         assert out["evidence"] == str(tmp_path / "bench_evidence.json")

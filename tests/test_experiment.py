@@ -514,9 +514,103 @@ class TestBenchHarness:
         result, picks = bench.run_kcenter_phase(8, dim=16, pool_n=128)
         assert result["ips"] > 0 and result["budget"] == 8
         assert result["unit"] == "picks/sec"
-        # The timed picks ride along so the Pallas A/B can compare
-        # without re-running the whole XLA scan.
+        assert result["backend"] in ("xla", "xla-batched")
         assert len(picks) == 8 and len(set(picks.tolist())) == 8
+
+
+class TestCollapseGuard:
+    """The evidence protocol's dead-round guard (VERDICT r5 #3,
+    scripts/cifar10_evidence.py): a fit whose BEST validation accuracy
+    is at chance re-initializes and retrains, bounded, with retries
+    recorded — no headline curve rides through a collapsed round."""
+
+    def _guarded(self, monkeypatch, perf_script):
+        """Build a guarded RandomSampler whose base train() is scripted
+        to report the next best_perf from ``perf_script`` and count
+        calls — collapse behavior without real (re)training."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "scripts"))
+        import cifar10_evidence as ev
+        from active_learning_tpu.strategies import get_strategy
+        from active_learning_tpu.strategies.base import Strategy
+
+        from helpers import make_strategy
+
+        calls = {"train": 0, "init": 0}
+        script = list(perf_script)
+
+        def fake_train(self):
+            calls["train"] += 1
+            self.best_perf = script.pop(0)
+
+        def fake_init(self):
+            calls["init"] += 1
+
+        monkeypatch.setattr(Strategy, "train", fake_train)
+        monkeypatch.setattr(Strategy, "init_network_weights", fake_init)
+        name = ev._collapse_guarded("RandomSampler")
+        assert get_strategy(name) is not None
+        strategy = make_strategy(name, init_pool=8)
+        return strategy, calls
+
+    def test_collapsed_round_reinits_and_records(self, monkeypatch):
+        # chance = 1/4 classes; 0.2 <= 0.25 * 1.25 => collapsed twice,
+        # then escapes at 0.9.
+        strategy, calls = self._guarded(monkeypatch, [0.2, 0.2, 0.9])
+        init_before = calls["init"]
+        strategy.train()
+        assert calls["train"] == 3
+        assert calls["init"] - init_before == 2  # one re-init per retry
+        assert strategy.collapse_retries == {0: 2}
+        assert strategy.best_perf == 0.9
+
+    def test_healthy_round_untouched(self, monkeypatch):
+        strategy, calls = self._guarded(monkeypatch, [0.9])
+        strategy.train()
+        assert calls["train"] == 1
+        assert getattr(strategy, "collapse_retries", {}) == {}
+
+    def test_es0_fit_uses_explicit_eval_not_zero(self, monkeypatch):
+        """The evidence protocol runs early_stop_patience=0, which
+        DISABLES per-epoch validation (trainer.fit's use_es gate) and
+        leaves FitResult.best_perf at 0.0 — the guard must then
+        evaluate the final weights explicitly instead of reading the
+        0.0 gate value and re-training every healthy round 3x.  Pinned
+        mechanically (retries bounded to 0 so a marginal tiny model
+        can't make it flaky): after one REAL es=0 fit, the guard's
+        best_perf equals the explicit eval-split accuracy of the
+        trained state, not 0.0-by-gate."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "scripts"))
+        import cifar10_evidence as ev
+        from helpers import make_strategy
+
+        monkeypatch.setattr(ev, "MAX_COLLAPSE_RETRIES", 0)
+        name = ev._collapse_guarded("RandomSampler")
+        strategy = make_strategy(name, init_pool=32, n_epoch=12)
+        strategy.cfg.early_stop_patience = 0  # the protocol's setting
+        strategy.train()
+        explicit = float(strategy.trainer.evaluate(
+            strategy.state, strategy.al_set,
+            strategy.pool.eval_idxs)["accuracy"])
+        assert strategy.best_perf == explicit
+        # At this epoch count the (seeded, deterministic) fit lands
+        # strictly above 0 on the eval split, so the equality above is
+        # a REAL discrimination from the 0.0 gate value, not 0.0==0.0.
+        assert strategy.best_perf > 0.0
+
+    def test_retry_bound_holds(self, monkeypatch):
+        # Never escapes chance: exactly MAX_COLLAPSE_RETRIES retries,
+        # then give up with the retries on the record.  (3 scripted
+        # perfs = 1 try + MAX_COLLAPSE_RETRIES=2 retries.)
+        strategy, calls = self._guarded(monkeypatch, [0.2, 0.2, 0.2])
+        import cifar10_evidence as ev
+
+        strategy.train()
+        assert calls["train"] == ev.MAX_COLLAPSE_RETRIES + 1
+        assert strategy.collapse_retries == {0: ev.MAX_COLLAPSE_RETRIES}
 
 
 def test_resume_refuses_other_model_format(tmp_path):
@@ -689,8 +783,12 @@ class TestBenchEvidence:
                          test_accuracy_rd1=0.8125,
                          phases_sec={"round0": {"train_time": 100.0}})
         if name == "kcenter_select":
-            extra.update(unit="picks/sec", pallas_speedup=1.23,
-                         pallas_picks_match=True)
+            extra.update(unit="picks/sec", backend="xla-batched")
+        if name == "serve_throughput":
+            extra.update(unit="scored images/sec (served)",
+                         qps_closed=137.2, p99_ms_closed=25.0,
+                         request_path_compiles=0,
+                         batch_occupancy={"8": {"4": 64, "8": 236}})
         return self._entry(name, **extra)
 
     def test_compact_line_bounded_all_phases_full(self, capsys, tmp_path):
@@ -744,7 +842,8 @@ class TestBenchEvidence:
         entry = self._entry(
             "x", mfu=0.3, unit="picks/sec", cached=True, ips_warm=1.0,
             round_sec_warm=1.0, round_sec_cold=2.0, test_accuracy_rd1=0.5,
-            pallas_speedup=1.5)
+            qps_closed=137.2, p99_ms_closed=25.0, request_path_compiles=0,
+            backend="xla-batched")
         out = {
             "metric": "m" * 60, "value": 1.0, "unit": "u",
             "vs_baseline": 1.0, "backend_probe": {"ok": True},
