@@ -218,6 +218,40 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Run-wide telemetry (active_learning_tpu/telemetry/, DESIGN.md §7):
+    per-step/per-epoch train + scoring metrics through the MetricsSink,
+    heartbeat liveness, host-span traces, and Prometheus exposition.
+
+    ``enabled`` is the master switch and is ON by default — the
+    default-on pieces (step-time/imgs-per-sec/grad-norm collection, the
+    heartbeat file, the jit-compile counter) cost two perf_counter
+    calls and a rate-limited dict merge per step.  Trace export and the
+    stall watchdog are opt-in on top.
+    """
+
+    enabled: bool = True
+    # Heartbeat rewrite cadence floor (phase transitions force a write
+    # regardless); heartbeat.json lands in --log_dir, per-process on
+    # pods (heartbeat_p{i}.json).
+    heartbeat_every_s: float = 5.0
+    # Chrome trace-event export: log_dir/trace.json, loadable in
+    # Perfetto / chrome://tracing.  Off by default (the event buffer is
+    # bounded either way).
+    export_trace: bool = False
+    # In-process stall watchdog: logs + emits a ``stall_suspected``
+    # metric when the progress counter freezes past the deadline.  The
+    # same deadline is embedded in heartbeat.json for EXTERNAL probes
+    # (the ``status`` verb flags staleness off the file's mtime).
+    watchdog: bool = False
+    stall_deadline_s: float = 600.0
+    # Prometheus textfile-collector scrape file (atomic rewrite); None
+    # disables.  The serve path exposes the same exposition format live
+    # at /metrics?format=prometheus.
+    prometheus_file: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ImbalanceConfig:
     """Synthetic class-imbalance parameters.
 
@@ -333,6 +367,10 @@ class ExperimentConfig:
 
     # VAAL
     vaal: VAALConfig = dataclasses.field(default_factory=VAALConfig)
+
+    # Run-wide telemetry (heartbeat/spans/per-step metrics/Prometheus).
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig)
 
     # Seeds (reference hard-codes eval split seed 99 and init pool seed 98,
     # main_al.py:71,83; the rest of the run uses the global np.random state —

@@ -16,7 +16,8 @@ from __future__ import annotations
 import argparse
 from typing import List, Optional
 
-from ..config import ExperimentConfig, ImbalanceConfig, VAALConfig
+from ..config import (ExperimentConfig, ImbalanceConfig, TelemetryConfig,
+                      VAALConfig)
 
 
 def get_parser() -> argparse.ArgumentParser:
@@ -68,6 +69,31 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug_mode", action="store_true")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="capture an XLA profiler trace to this directory")
+    # Run-wide telemetry (active_learning_tpu/telemetry/, DESIGN.md §7).
+    # Default ON: per-step/per-epoch metrics through the sink + the
+    # heartbeat file; trace export and the watchdog are opt-in.
+    p.add_argument("--disable_telemetry", action="store_true",
+                   help="turn off per-step metrics, heartbeat, and the "
+                        "compile counter (trace/watchdog imply nothing "
+                        "when this is set)")
+    p.add_argument("--heartbeat_every_s", type=float, default=5.0,
+                   help="heartbeat.json rewrite cadence floor (phase "
+                        "transitions always force a write)")
+    p.add_argument("--export_trace", action="store_true",
+                   help="export nested host spans as Chrome trace-event "
+                        "JSON to <log_dir>/trace.json (Perfetto / "
+                        "chrome://tracing)")
+    p.add_argument("--watchdog", action="store_true",
+                   help="in-process stall watchdog: log + emit a "
+                        "stall_suspected metric when progress halts past "
+                        "--stall_deadline_s")
+    p.add_argument("--stall_deadline_s", type=float, default=600.0,
+                   help="stall deadline for the watchdog AND the "
+                        "staleness threshold embedded in heartbeat.json "
+                        "(the `status` verb reads it)")
+    p.add_argument("--prometheus_file", type=str, default=None,
+                   help="atomically rewrite this Prometheus textfile-"
+                        "collector scrape file with run gauges")
     # Compute precision (TPU-specific; the reference is fp32-only,
     # get_networks.py:28-29).  Default defers to the arg pool's
     # TrainConfig.dtype, whose "auto" means bf16 on TPU / f32 elsewhere.
@@ -155,6 +181,13 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         download_data=args.download_data,
         debug_mode=args.debug_mode,
         profile_dir=args.profile_dir,
+        telemetry=TelemetryConfig(
+            enabled=not args.disable_telemetry,
+            heartbeat_every_s=args.heartbeat_every_s,
+            export_trace=args.export_trace,
+            watchdog=args.watchdog,
+            stall_deadline_s=args.stall_deadline_s,
+            prometheus_file=args.prometheus_file),
         dtype=args.dtype,
         bn_stats_dtype=args.bn_stats_dtype,
         stem=args.stem,
@@ -181,14 +214,21 @@ def main(argv: Optional[List[str]] = None):
     import sys
 
     argv = list(sys.argv[1:]) if argv is None else list(argv)
-    # The one verb this CLI carries beyond the reference's flat flag
+    # The verbs this CLI carries beyond the reference's flat flag
     # surface: ``serve`` opens the ONLINE path (predictions +
     # acquisition scores over HTTP from an experiment's best
-    # checkpoint — active_learning_tpu/serve/).  Flat invocations stay
+    # checkpoint — active_learning_tpu/serve/) and ``status`` renders a
+    # live run summary (telemetry/status.py).  Flat invocations stay
     # byte-compatible with every published reference command.
     if argv and argv[0] == "serve":
         from ..serve.cli import main as serve_main
         return serve_main(argv[1:])
+    # ``status``: render a live run summary from heartbeat + metrics —
+    # stdlib only, answers in milliseconds with NO jax import (it must
+    # work from any shell against a wedged run).
+    if argv and argv[0] == "status":
+        from ..telemetry.status import main as status_main
+        return status_main(argv[1:])
     from .driver import run_experiment
     args = get_parser().parse_args(argv)
     # run_experiment performs the jax.distributed rendezvous itself (a

@@ -36,12 +36,30 @@ from ..models.factory import get_network
 from ..parallel import mesh as mesh_lib
 from ..pool import PoolState
 from ..strategies import get_strategy
+from ..telemetry import runtime as tele_runtime
+from ..telemetry import spans as tele_spans
 from ..utils.logging import get_logger, setup_logging
 from ..utils.metrics import MetricsSink, make_sink
 from ..utils.tracing import phase_timer, profiler_session
 from ..train.trainer import Trainer
 from . import arg_pools as arg_pools_lib
 from . import resume as resume_lib
+
+
+def _platform_is_cpu() -> bool:
+    """True when the configured JAX platform list names cpu first —
+    WITHOUT initializing a backend (this runs before the multi-host
+    rendezvous on some call paths).  Unset platform config reads as
+    not-CPU: accelerator machines rarely set it, CPU test/smoke
+    environments always do (conftest, the tier-1 recipe, bench's CPU
+    children)."""
+    spec = (os.environ.get("JAX_PLATFORMS") or "")
+    try:
+        spec = jax.config.jax_platforms or spec
+    except AttributeError:  # pragma: no cover - very old jax
+        pass
+    first = spec.split(",")[0].strip().lower() if spec else ""
+    return first == "cpu"
 
 
 def enable_compilation_cache(cache_dir: Optional[str] = None
@@ -58,10 +76,30 @@ def enable_compilation_cache(cache_dir: Optional[str] = None
     ~/.cache/al_tpu_xla_cache; "" disables.  Returns the directory in
     use, or None when disabled/unavailable (old jax without the config
     knobs — the run proceeds uncached, never fails).
+
+    CPU backends get NO cache by default: jax 0.4.37's CPU runtime
+    corrupts donated buffers when an executable is deserialized from the
+    persistent cache (a donate_argnums jit re-jitted in-process dies
+    with heap corruption or silently computes on freed memory — the
+    root cause of the once-flaky mid-round-resume tests).  Compiles are
+    cheap on CPU anyway; an EXPLICIT choice — the cache_dir argument OR
+    $JAX_COMPILATION_CACHE_DIR — still enables it (both are deliberate
+    operator opt-ins), and accelerators are unaffected.
     """
     if cache_dir == "":
         return None
-    cache_dir = (cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    # The env var is an explicit operator opt-in, same as the flag — it
+    # must be resolved BEFORE the CPU gate, which suppresses only the
+    # implicit ~/.cache default.
+    cache_dir = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not cache_dir and _platform_is_cpu():
+        get_logger().info(
+            "persistent compilation cache disabled on the CPU backend "
+            "(jax 0.4.37 corrupts donated buffers in cache-deserialized "
+            "executables); pass --compilation_cache_dir or set "
+            "$JAX_COMPILATION_CACHE_DIR to force it")
+        return None
+    cache_dir = (cache_dir
                  or os.path.join(os.path.expanduser("~"), ".cache",
                                  "al_tpu_xla_cache"))
     try:
@@ -183,6 +221,31 @@ def build_experiment(
     return strategy
 
 
+def _emit_round_telemetry(telemetry, sink: MetricsSink, rd: int,
+                          strategy) -> None:
+    """Round-boundary telemetry: the jit-compile miss delta (round 0
+    carries the cold tax; ANY nonzero delta after it is a shape leak —
+    the test_compile_reuse regression, now visible in production
+    metrics), the HBM high-water where the backend exposes
+    memory_stats, the Prometheus gauge refresh, and an incremental
+    trace export so a crash mid-run still leaves trace.json on disk."""
+    if not telemetry.train_metrics:
+        return
+    delta = telemetry.jit_cache_delta()
+    sink.log_metric("jit_cache_miss_delta", delta, step=rd)
+    hbm = tele_runtime.hbm_high_water_gb()
+    if hbm is not None:
+        sink.log_metric("hbm_peak_gb", hbm, step=rd)
+    telemetry.set_gauges(
+        round=rd, cumulative_budget=strategy.pool.cumulative_cost,
+        labeled=strategy.pool.num_labeled,
+        jit_cache_total=telemetry.jit_cache_total(),
+        hbm_peak_gb=hbm)
+    telemetry.write_prometheus()
+    telemetry.export_trace()
+    telemetry.tick(force=True, phase="round_end", round=rd)
+
+
 def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
                    data=None, mesh=None,
                    train_cfg: Optional[TrainConfig] = None, model=None):
@@ -233,65 +296,108 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         sink = make_sink(cfg.enable_metrics and mesh_lib.is_coordinator(),
                          cfg.log_dir, experiment_key=key,
                          backend=cfg.metrics_backend)
-    strategy = build_experiment(cfg, sink=sink, data=data, mesh=mesh,
-                                train_cfg=train_cfg, model=model,
-                                skip_init_pool=resuming)
-    if resuming:
-        start_round = resume_lib.load_experiment(strategy, cfg)
-        # The first fit of a resumed run may consume a mid-round fit state
-        # (epoch-level recovery); non-resumed runs discard stale ones.
-        strategy.resume_next_fit = True
-    else:
-        start_round = 0
-        sink.log_parameters(config_to_dict(cfg))
+    # Run-wide telemetry (DESIGN.md §7): heartbeat + spans + per-step
+    # metrics + optional watchdog/trace/scrape file, installed BEFORE the
+    # stack is built so the trainer/strategies register their jitted
+    # steps with the compile counter.  The watchdog's stall event rides
+    # the metrics sink (thread-safe by JsonlSink's lock).
+    def _on_stall(stalled_s: float) -> None:
+        logger.warning(
+            f"watchdog: no progress for {stalled_s:.0f}s (deadline "
+            f"{cfg.telemetry.stall_deadline_s:.0f}s) — stall suspected")
+        sink.log_metric("stall_suspected", round(stalled_s, 1))
+        tele_spans.get_tracer().instant(
+            "stall_suspected", args={"stalled_s": round(stalled_s, 1)})
 
-    init_pool_size = cfg.resolved_init_pool_size()
-    logger.info(f"Experiment Name: {cfg.exp_name}")
-    logger.info(f"Dataset: {cfg.dataset}")
-    logger.info(f"Strategy: {cfg.strategy}")
-    logger.info(f"Budget used before starting: {strategy.pool.num_labeled}")
-    logger.info(f"Log file name: {log_filename}")
-    logger.info(f"Mesh: {strategy.mesh.devices.size} devices")
+    telemetry = tele_runtime.start_run(
+        cfg.telemetry, log_dir=cfg.log_dir,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(), logger=logger,
+        on_stall=_on_stall)
 
-    with profiler_session(cfg.profile_dir):
-        for rd in range(start_round, cfg.rounds):
-            strategy.round = rd
-            logger.info(f"Active Learning Round {rd} start.")
-            # Pool residency is default behavior: re-size the auto budget
-            # from live HBM headroom at every round start (a no-op for
-            # explicit integer budgets; already-uploaded pools stay
-            # resident regardless — parallel/resident.cached).
-            budget = strategy.trainer.refresh_resident_budget()
-            logger.info(
-                f"Resident pool budget for round {rd}: "
-                f"{budget / 1e9:.2f} GB "
-                f"({'auto' if strategy.train_cfg.resident_scoring_bytes is None else 'explicit'})")
+    # Everything from here runs under the run's telemetry; the finally
+    # below both finishes it (final heartbeat status + trace export) and
+    # UNINSTALLS it — an exception anywhere, including setup, must not
+    # leak an installed runtime into the next in-process run.
+    status = "crashed"
+    try:
+        strategy = build_experiment(cfg, sink=sink, data=data, mesh=mesh,
+                                    train_cfg=train_cfg, model=model,
+                                    skip_init_pool=resuming)
+        if resuming:
+            start_round = resume_lib.load_experiment(strategy, cfg)
+            # The first fit of a resumed run may consume a mid-round fit
+            # state (epoch-level recovery); non-resumed runs discard
+            # stale ones.
+            strategy.resume_next_fit = True
+        else:
+            start_round = 0
+            sink.log_parameters(config_to_dict(cfg))
 
-            # Round 0 only queries when there is no initial pool — with an
-            # SSL or transfer-learned init the model can score the pool
-            # before any labels exist (main_al.py:149-157).
-            al_round_0 = rd == 0 and init_pool_size == 0
-            if rd > 0 or al_round_0:
-                if al_round_0:
-                    strategy.init_network_weights()
-                with phase_timer("query_time", rd, sink, logger):
-                    labeled_idxs, cur_cost = strategy.query(
-                        cfg.round_budget)
-                strategy.update(labeled_idxs, cur_cost)
+        init_pool_size = cfg.resolved_init_pool_size()
+        logger.info(f"Experiment Name: {cfg.exp_name}")
+        logger.info(f"Dataset: {cfg.dataset}")
+        logger.info(f"Strategy: {cfg.strategy}")
+        logger.info(
+            f"Budget used before starting: {strategy.pool.num_labeled}")
+        logger.info(f"Log file name: {log_filename}")
+        logger.info(f"Mesh: {strategy.mesh.devices.size} devices")
 
-            with phase_timer("init_network_weights_time", rd, sink, logger):
-                strategy.init_network_weights()
-            with phase_timer("train_time", rd, sink, logger):
-                strategy.train()
-            with phase_timer("load_best_ckpt_time", rd, sink, logger):
-                strategy.load_best_ckpt()
-            with phase_timer("test_time", rd, sink, logger):
-                strategy.test()
+        with profiler_session(cfg.profile_dir), \
+                tele_spans.get_tracer().span(
+                    "experiment", args={"exp_name": cfg.exp_name,
+                                        "exp_hash": cfg.exp_hash}):
+            for rd in range(start_round, cfg.rounds):
+                with tele_spans.get_tracer().span("round",
+                                                  args={"round": rd}):
+                    strategy.round = rd
+                    telemetry.tick(force=True, round=rd,
+                                   phase="round_start", epoch=0, step=0)
+                    logger.info(f"Active Learning Round {rd} start.")
+                    # Pool residency is default behavior: re-size the auto
+                    # budget from live HBM headroom at every round start (a
+                    # no-op for explicit integer budgets; already-uploaded
+                    # pools stay resident regardless —
+                    # parallel/resident.cached).
+                    budget = strategy.trainer.refresh_resident_budget()
+                    logger.info(
+                        f"Resident pool budget for round {rd}: "
+                        f"{budget / 1e9:.2f} GB "
+                        f"({'auto' if strategy.train_cfg.resident_scoring_bytes is None else 'explicit'})")
 
-            if mesh_lib.is_coordinator():
-                resume_lib.save_experiment(strategy, cfg)
-            cfg.resume_training = True  # crash after this resumes (main_al.py:181)
-            if len(strategy.available_query_idxs(shuffle=False)) == 0:
-                logger.info("Finished querying all Images!")
-                break
+                    # Round 0 only queries when there is no initial pool —
+                    # with an SSL or transfer-learned init the model can
+                    # score the pool before any labels exist
+                    # (main_al.py:149-157).
+                    al_round_0 = rd == 0 and init_pool_size == 0
+                    if rd > 0 or al_round_0:
+                        if al_round_0:
+                            strategy.init_network_weights()
+                        with phase_timer("query_time", rd, sink, logger):
+                            labeled_idxs, cur_cost = strategy.query(
+                                cfg.round_budget)
+                        strategy.update(labeled_idxs, cur_cost)
+
+                    with phase_timer("init_network_weights_time", rd, sink,
+                                     logger):
+                        strategy.init_network_weights()
+                    with phase_timer("train_time", rd, sink, logger):
+                        strategy.train()
+                    with phase_timer("load_best_ckpt_time", rd, sink,
+                                     logger):
+                        strategy.load_best_ckpt()
+                    with phase_timer("test_time", rd, sink, logger):
+                        strategy.test()
+
+                    if mesh_lib.is_coordinator():
+                        resume_lib.save_experiment(strategy, cfg)
+                    cfg.resume_training = True  # crash after this resumes (main_al.py:181)
+                _emit_round_telemetry(telemetry, sink, rd, strategy)
+                if len(strategy.available_query_idxs(shuffle=False)) == 0:
+                    logger.info("Finished querying all Images!")
+                    break
+        status = "finished"
+    finally:
+        telemetry.finish(status)
+        tele_runtime.uninstall(telemetry)
     return strategy

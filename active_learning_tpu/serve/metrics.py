@@ -88,3 +88,51 @@ class ServeMetrics:
 
 def _ms(v: Optional[float]) -> Optional[float]:
     return None if v is None else round(v * 1000.0, 3)
+
+
+def prometheus_samples(snap: Dict) -> list:
+    """The enriched /metrics snapshot (server._metrics output) as
+    (name, labels, value) samples for telemetry/prom.render — the
+    ``?format=prometheus`` view.  Counter-like values stay gauges with a
+    _total suffix: they are process-lifetime snapshots and reset with
+    the process."""
+    samples = [
+        ("al_serve_uptime_seconds", None, snap.get("uptime_s")),
+        ("al_serve_rows_served_total", None, snap.get("rows_served")),
+        ("al_serve_qps", None, snap.get("qps")),
+        ("al_serve_served_round", None, snap.get("served_round")),
+    ]
+    for endpoint, count in sorted((snap.get("requests") or {}).items()):
+        samples.append(("al_serve_requests_total",
+                        {"endpoint": endpoint}, count))
+    for status, count in sorted((snap.get("responses") or {}).items()):
+        samples.append(("al_serve_responses_total",
+                        {"status": str(status)}, count))
+    lat = snap.get("latency_ms") or {}
+    for q, key in (("0.5", "p50"), ("0.99", "p99")):
+        if lat.get(key) is not None:
+            samples.append(("al_serve_request_latency_ms",
+                            {"quantile": q}, lat[key]))
+    samples.append(("al_serve_latency_window_size", None, lat.get("n")))
+    for bucket, hist in sorted((snap.get("batch_occupancy") or {}).items()):
+        for rows, count in sorted(hist.items()):
+            samples.append(("al_serve_batch_occupancy_total",
+                            {"bucket": str(bucket), "rows": str(rows)},
+                            count))
+    queue = snap.get("queue") or {}
+    samples.append(("al_serve_queue_pending_rows", None,
+                    queue.get("pending_rows")))
+    samples.append(("al_serve_queue_depth", None, queue.get("depth")))
+    ex = snap.get("executor") or {}
+    for key in ("batches", "rows", "reloads"):
+        if key in ex:
+            samples.append((f"al_serve_executor_{key}_total", None,
+                            ex[key]))
+    compiles = snap.get("compiles") or {}
+    # THE serving contract, scrapable: 0 after warmup, forever.
+    samples.append(("al_serve_request_path_compiles", None,
+                    compiles.get("request_path_compiles")))
+    for step, count in sorted((compiles.get("per_step") or {}).items()):
+        samples.append(("al_serve_jit_cache_entries",
+                        {"step": step}, count))
+    return samples

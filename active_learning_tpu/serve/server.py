@@ -169,10 +169,23 @@ class ScoringServer:
 
     async def _route(self, method: str, path: str, body: bytes
                      ) -> Tuple[int, Dict, Dict[str, str]]:
+        path, _, query = path.partition("?")
         try:
             if method == "GET" and path == "/healthz":
                 return 200, self._healthz(), {}
             if method == "GET" and path == "/metrics":
+                from urllib.parse import parse_qs
+                fmt = (parse_qs(query).get("format") or [""])[0]
+                if fmt == "prometheus":
+                    # Text exposition for stock scrapers; the JSON view
+                    # stays the default (loadgen/bench read it).
+                    return 200, self._metrics_prometheus(), {
+                        "Content-Type":
+                            "text/plain; version=0.0.4; charset=utf-8"}
+                if fmt and fmt != "json":
+                    raise _HttpError(400, f"unknown metrics format "
+                                          f"{fmt!r}; use json or "
+                                          "prometheus")
                 return 200, self._metrics(), {}
             if method == "POST" and path in ("/v1/predict", "/v1/score"):
                 self.metrics.record_request(path)
@@ -259,6 +272,14 @@ class ScoringServer:
         }
         return snap
 
+    def _metrics_prometheus(self) -> str:
+        """The same snapshot as text exposition (format 0.0.4) through
+        the shared encoder (telemetry/prom.py) — both workloads are
+        monitorable by stock Prometheus tooling."""
+        from ..telemetry import prom
+        from .metrics import prometheus_samples
+        return prom.render(prometheus_samples(self._metrics()))
+
 
 # -- wire helpers ------------------------------------------------------------
 
@@ -295,15 +316,24 @@ async def _read_request(reader: asyncio.StreamReader):
 
 
 def _write_response(writer: asyncio.StreamWriter, status: int,
-                    payload: Dict, extra_headers: Dict[str, str],
+                    payload, extra_headers: Dict[str, str],
                     keep_alive: bool) -> None:
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
               413: "Payload Too Large", 429: "Too Many Requests",
               500: "Internal Server Error",
               503: "Service Unavailable"}.get(status, "")
-    body = json.dumps(payload).encode()
+    extra_headers = dict(extra_headers)
+    if isinstance(payload, str):
+        # Text payloads (the Prometheus exposition view) carry their own
+        # Content-Type via extra_headers.
+        body = payload.encode()
+        ctype = extra_headers.pop("Content-Type",
+                                  "text/plain; charset=utf-8")
+    else:
+        body = json.dumps(payload).encode()
+        ctype = "application/json"
     head = [f"HTTP/1.1 {status} {reason}",
-            "Content-Type: application/json",
+            f"Content-Type: {ctype}",
             f"Content-Length: {len(body)}",
             f"Connection: {'keep-alive' if keep_alive else 'close'}"]
     head += [f"{k}: {v}" for k, v in extra_headers.items()]

@@ -27,6 +27,7 @@ Key architectural differences (deliberate, TPU-first):
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
@@ -304,18 +305,34 @@ class Strategy:
                     self.model, view, pool_512=True)
             else:
                 raise KeyError(f"unknown scoring kind '{kind}'")
+            # Compile accounting (telemetry/runtime.py): scoring steps
+            # join the trainer's in the generalized jit-cache counter —
+            # a nonzero per-round miss delta after round 1 is a shape
+            # leak.  No-op without an installed run.
+            from ..telemetry import runtime as tele_runtime
+            tele_runtime.get_run().register_jit(
+                f"score_{kind}@{id(self):x}", self._score_steps[kind])
         return self._score_steps[kind]
 
     def collect_scores(self, idxs: np.ndarray, kind: str,
                        keys=None) -> Dict[str, np.ndarray]:
         """Mesh-parallel scoring pass over ``al_set[idxs]`` returning host
-        arrays aligned with ``idxs``."""
+        arrays aligned with ``idxs``.  With telemetry on, the pass's
+        pool-scan rate lands in the sink as ``pool_rows_per_sec`` —
+        the acquisition-side counterpart of the trainer's imgs_per_sec."""
+        from ..telemetry import runtime as tele_runtime
         loader = self.train_cfg.loader_te
-        return scoring.collect_pool(
+        t0 = time.perf_counter()
+        out = scoring.collect_pool(
             self.al_set, idxs, self._score_batch_size(),
             self._get_score_step(kind), self.state.variables, self.mesh,
             num_workers=loader.num_workers, prefetch=loader.prefetch,
             keys=keys, **self._resident_kwargs())
+        dt = time.perf_counter() - t0
+        if tele_runtime.get_run().train_metrics and dt > 0:
+            self.sink.log_metric("pool_rows_per_sec",
+                                 round(len(idxs) / dt, 1), step=self.round)
+        return out
 
     def _resident_kwargs(self) -> Dict:
         """collect_pool kwargs for the device-resident pool: one gating

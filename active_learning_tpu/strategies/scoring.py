@@ -20,6 +20,7 @@ scores.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
@@ -325,6 +326,31 @@ def collect_pool(
     if n == 0:
         raise ValueError("collect_pool called with empty idxs; guard the "
                          "exhausted-pool case in the sampler")
+    # Telemetry: chunk-granular spans + heartbeat ticks over the pool
+    # scan (experiment → round → phase → collect_pool chunk in the
+    # trace).  A chunk is the streaming path's flush unit (FETCH_EVERY
+    # batches); both objects are inert no-ops unless a run installed
+    # telemetry.
+    from ..telemetry import runtime as tele_runtime
+    from ..telemetry import spans as tele_spans
+    tracer = tele_spans.get_tracer()
+    tele = tele_runtime.get_run()
+    t_pool0 = time.perf_counter()
+    # Bulk-fetch cadence of the streaming path AND the chunk-span/tick
+    # granularity of both paths (single-process: keep per-batch outputs
+    # ON DEVICE and fetch every FETCH_EVERY batches — a per-batch
+    # np.asarray is a blocking round-trip that serializes the whole
+    # pipeline on a remote/tunneled runtime, measured 10x+ end-to-end;
+    # deferred fetches let async dispatch overlap decode, h2d, and
+    # compute, bounding extra HBM to ~FETCH_EVERY batches of outputs).
+    FETCH_EVERY = 32
+
+    def chunk_span(t0: float, first_batch: int, n_batches: int,
+                   rows: int) -> None:
+        tracer.complete(
+            "collect_pool_chunk", t0, time.perf_counter(),
+            args={"batches": n_batches, "first_batch": first_batch,
+                  "rows": rows})
     # Device-resident fast path for in-memory pools: upload once per
     # experiment (the caller owns ``resident_cache``), then every batch of
     # every round's every sampler is an on-device gather — zero image
@@ -339,7 +365,8 @@ def collect_pool(
         run = resident_lib.get_runner(resident_cache, step_fn, mesh)
         multi = mesh_lib.is_multiprocess(mesh)
         chunks: Dict[str, list] = {}
-        for b in batch_index_lists(idxs, batch_size):
+        t_chunk, chunk_first = t_pool0, 0
+        for i, b in enumerate(batch_index_lists(idxs, batch_size)):
             ids, mask = padded_batch_layout(b, batch_size)
             small = mesh_lib.replicate((ids.astype(np.int32), mask), mesh)
             out = run(variables, images_dev, *small)
@@ -351,6 +378,15 @@ def collect_pool(
                 # that sync behind its threaded decode; here there is no
                 # host work to overlap).  One fetch at the end.
                 chunks.setdefault(k, []).append(v)
+            if (i + 1) % FETCH_EVERY == 0:
+                tele.tick(step=i + 1)
+                chunk_span(t_chunk, chunk_first, i + 1 - chunk_first,
+                           min((i + 1) * batch_size, n))
+                t_chunk, chunk_first = time.perf_counter(), i + 1
+        if i + 1 > chunk_first:
+            chunk_span(t_chunk, chunk_first, i + 1 - chunk_first, n)
+        tracer.complete("collect_pool", t_pool0, time.perf_counter(),
+                        args={"rows": n, "path": "resident"})
         if multi:
             return _finalize(chunks, True, mesh, n)
         return {k: np.asarray(jnp.concatenate(v, axis=0))[:n]
@@ -365,15 +401,6 @@ def collect_pool(
     layouts = [padded_batch_layout(b, batch_size)[0]
                for b in batch_index_lists(idxs, batch_size)]
     chunks: Dict[str, list] = {}
-    # Single-process: keep per-batch outputs ON DEVICE and fetch in bulk
-    # every FETCH_EVERY batches — a per-batch np.asarray is a blocking
-    # round-trip that serializes the whole pipeline on a remote/tunneled
-    # runtime (measured 10x+ end-to-end slowdown), while deferred fetches
-    # let async dispatch overlap decode, h2d, and compute.  The periodic
-    # flush (device concat -> ONE host fetch -> buffers freed) bounds the
-    # extra HBM to ~FETCH_EVERY batches of outputs even for [B, D]
-    # embedding passes over a large pool.
-    FETCH_EVERY = 32
     pending: Dict[str, list] = {}
 
     def flush():
@@ -404,6 +431,8 @@ def collect_pool(
     # max(host feed, PCIe, device) instead of their sum — the fallback
     # leg of the pool-residency default.
     from ..data.cache import device_prefetch
+    t_chunk, chunk_first = time.perf_counter(), 0
+    i = -1
     for i, sharded in enumerate(device_prefetch(
             checked_host_batches(),
             lambda b: mesh_lib.shard_batch(b, mesh))):
@@ -415,8 +444,20 @@ def collect_pool(
             # after the loop — a per-batch gather would serialize a DCN
             # round-trip into every step of the acquisition hot path.
             (chunks if multi else pending).setdefault(k, []).append(v)
-        if not multi and (i + 1) % FETCH_EVERY == 0:
-            flush()
+        if (i + 1) % FETCH_EVERY == 0:
+            if not multi:
+                # Periodic flush (device concat -> ONE host fetch ->
+                # buffers freed): bounds the extra HBM to ~FETCH_EVERY
+                # batches of outputs even for [B, D] embedding passes.
+                flush()
+            tele.tick(step=i + 1)
+            chunk_span(t_chunk, chunk_first, i + 1 - chunk_first,
+                       min((i + 1) * batch_size, n))
+            t_chunk, chunk_first = time.perf_counter(), i + 1
     if not multi:
         flush()
+    if i + 1 > chunk_first:
+        chunk_span(t_chunk, chunk_first, i + 1 - chunk_first, n)
+    tracer.complete("collect_pool", t_pool0, time.perf_counter(),
+                    args={"rows": n, "path": "stream"})
     return _finalize(chunks, multi, mesh, n)

@@ -1,0 +1,42 @@
+"""Run-wide telemetry: span traces, per-step train metrics, heartbeats,
+and Prometheus exposition.
+
+Four pieces, one install point (DESIGN.md §7):
+
+  * ``spans``     — hierarchical host spans (experiment → round → phase
+                    → epoch → collect_pool chunk) exported as Chrome
+                    trace-event JSON; ``utils/tracing.phase_timer`` is a
+                    thin shim over it, so phase metrics and phase spans
+                    are the same measurement.
+  * ``runtime``   — the per-run object (``start_run``/``get_run``):
+                    gauges, the generalized jit-compile counter, the
+                    Prometheus scrape file, lifecycle.
+  * ``heartbeat`` — atomically-rewritten ``heartbeat.json`` liveness +
+                    the in-process stall watchdog.
+  * ``prom``      — the shared Prometheus text encoder (the serve
+                    ``/metrics?format=prometheus`` view and the driver
+                    scrape file).
+
+``status.py`` is the read side: the ``status`` CLI verb renders a live
+run summary from heartbeat + metrics.jsonl with no jax import.
+
+Default-on at negligible cost: per-step collection is two perf_counter
+calls and a list append; heartbeat ticks are a lock + monotonic compare
+when rate-limited.  Trace export and the watchdog are opt-in
+(config.TelemetryConfig).  With telemetry off — or outside a driver run
+— the installed runtime is inert and the stack behaves exactly as
+before telemetry existed (pinned by tests/test_telemetry.py).
+"""
+
+from .heartbeat import (HeartbeatWriter, StallWatchdog, heartbeat_age_s,
+                        is_stale, read_heartbeat)
+from .runtime import (RunTelemetry, get_run, hbm_high_water_gb, install,
+                      percentile, start_run, uninstall)
+from .spans import Span, SpanTracer, get_tracer, set_tracer
+
+__all__ = [
+    "HeartbeatWriter", "StallWatchdog", "heartbeat_age_s", "is_stale",
+    "read_heartbeat", "RunTelemetry", "get_run", "hbm_high_water_gb",
+    "install", "percentile", "start_run", "uninstall", "Span",
+    "SpanTracer", "get_tracer", "set_tracer",
+]

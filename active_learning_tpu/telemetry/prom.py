@@ -1,0 +1,142 @@
+"""Prometheus text exposition (format 0.0.4) — stdlib only.
+
+One shared encoder serves both workloads: ``serve/server.py`` answers
+``GET /metrics?format=prometheus`` with it, and the driver's optional
+scrape file (TelemetryConfig.prometheus_file) is the same text written
+atomically for node-exporter's textfile collector — so stock Prometheus
+tooling monitors an AL run and a scoring service without any custom
+exporter.
+
+Everything is emitted as a gauge: counters here are process-lifetime
+snapshots read from one process's memory, and a gauge with a _total
+suffix scrapes identically while staying honest about resets.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+# (name, labels-or-None, value)
+Sample = Tuple[str, Optional[Dict[str, str]], Any]
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NAME_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize_name(name: str) -> str:
+    """A valid metric name from an arbitrary internal one (dots, dashes
+    and any other punctuation become underscores; a leading digit gets a
+    prefix)."""
+    name = _NAME_BAD_CHARS.sub("_", str(name))
+    if not name or not _NAME_OK.match(name):
+        name = f"_{name}"
+    return name
+
+
+def _escape_label_value(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _format_value(v: Any) -> Optional[str]:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 2**53:
+        return str(int(f))
+    return repr(f)
+
+
+def render(samples: Iterable[Sample],
+           help_map: Optional[Mapping[str, str]] = None) -> str:
+    """Prometheus exposition text from (name, labels, value) samples.
+
+    Samples sharing a name are grouped under one ``# TYPE`` header (the
+    format requires it); None/unconvertible values are dropped rather
+    than emitted as parse errors for the scraper."""
+    by_name: Dict[str, List[Tuple[Optional[Dict[str, str]], str]]] = {}
+    order: List[str] = []
+    for name, labels, value in samples:
+        text = _format_value(value)
+        if text is None:
+            continue
+        name = sanitize_name(name)
+        if name not in by_name:
+            by_name[name] = []
+            order.append(name)
+        by_name[name].append((labels, text))
+    lines: List[str] = []
+    for name in order:
+        if help_map and name in help_map:
+            lines.append(f"# HELP {name} {help_map[name]}")
+        lines.append(f"# TYPE {name} gauge")
+        for labels, text in by_name[name]:
+            if labels:
+                body = ",".join(
+                    f'{_LABEL_BAD_CHARS.sub("_", str(k))}='
+                    f'"{_escape_label_value(v)}"'
+                    for k, v in sorted(labels.items()))
+                lines.append(f"{name}{{{body}}} {text}")
+            else:
+                lines.append(f"{name} {text}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def gauge_samples(gauges: Mapping[str, Any], prefix: str = ""
+                  ) -> List[Sample]:
+    """Flat name->value mapping as samples (the driver's gauge dict)."""
+    return [(f"{prefix}{name}", None, value)
+            for name, value in sorted(gauges.items())]
+
+
+def write_textfile(path: str, text: str) -> bool:
+    """Atomic scrape-file write (node-exporter textfile collector reads
+    whole files; a torn write would be a parse error for every metric in
+    it).  Never raises — a full disk must not kill the run."""
+    try:
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False
+
+
+def parse(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
+    """Minimal exposition parser: {name: {labels-tuple: value}}.  Exists
+    for tests (round-tripping what render produced) and for the status
+    verb; not a general scraper."""
+    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                     r"(?:\{(.*)\})?\s+(\S+)$", line)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, labelstr, value = m.groups()
+        labels: List[Tuple[str, str]] = []
+        if labelstr:
+            for part in re.findall(r'([a-zA-Z0-9_]+)="((?:[^"\\]|\\.)*)"',
+                                   labelstr):
+                k, v = part
+                v = (v.replace(r"\"", '"').replace(r"\n", "\n")
+                     .replace(r"\\", "\\"))
+                labels.append((k, v))
+        out.setdefault(name, {})[tuple(labels)] = float(value)
+    return out

@@ -1,0 +1,194 @@
+"""Hierarchical host-span tracing with Chrome-trace-event export.
+
+The framework's only run-time timing signal used to be the driver's
+round-granularity ``phase_timer`` wall-clocks — nothing between "a round
+took 219 s" and a full XLA profiler capture.  This tracer fills that gap
+with nested HOST spans (experiment → round → phase → epoch →
+collect_pool chunk) recorded at perf_counter resolution and exported as
+Chrome trace-event JSON (``trace.json``), loadable in Perfetto or
+``chrome://tracing`` with zero extra tooling.  Device-side naming stays
+with ``jax.profiler.TraceAnnotation`` (utils/tracing.annotate) — the
+two nest: every phase span still wraps its annotation, so an XProf
+capture and the host trace describe the same intervals.
+
+Design constraints, each load-bearing:
+
+  * **Timing is unconditional, recording is opt-in.**  ``span()`` always
+    measures (``phase_timer`` derives the ``rd_{name}`` metric from the
+    SAME span, so metrics and spans cannot fork — scripts/trace_lint.py
+    asserts the routing), but events are only appended when the tracer
+    is enabled (TelemetryConfig.export_trace).  A disabled span is two
+    ``perf_counter`` calls.
+  * **Thread-safe, bounded.**  The serve executor, watchdog, and data
+    feeder threads may all open spans; events append under a lock and
+    the buffer is capped (oldest runs are multi-hour — an unbounded
+    event list is a slow leak) with an explicit drop counter.
+  * **No jax dependency.**  Importable from the status verb and tests
+    without touching a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Span:
+    """One completed (or in-flight) host span."""
+
+    __slots__ = ("name", "args", "t0", "t1", "tid")
+
+    def __init__(self, name: str, args: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.args = args
+        self.t0 = time.perf_counter()
+        self.t1: Optional[float] = None
+        self.tid = threading.get_ident()
+
+    @property
+    def duration_s(self) -> float:
+        end = self.t1 if self.t1 is not None else time.perf_counter()
+        return end - self.t0
+
+
+class SpanTracer:
+    """Records nested host spans; exports one Chrome trace per run."""
+
+    def __init__(self, enabled: bool = True, max_events: int = 200_000):
+        self.enabled = bool(enabled)
+        self.max_events = int(max_events)
+        self.events: List[Dict[str, Any]] = []
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._origin = time.perf_counter()
+        self._wall_origin = time.time()
+        self._local = threading.local()
+
+    # -- span stack (per thread, for nesting introspection) ---------------
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def depth(self) -> int:
+        return len(self._stack())
+
+    # -- recording ---------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, args: Optional[Dict[str, Any]] = None
+             ) -> Iterator[Span]:
+        """Open a nested span.  Always measures; records only when
+        enabled.  The yielded Span's ``duration_s`` is valid after the
+        block exits (phase_timer reads it for the metrics sink)."""
+        sp = Span(name, args)
+        stack = self._stack()
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.t1 = time.perf_counter()
+            stack.pop()
+            if self.enabled:
+                self._record(sp)
+
+    def complete(self, name: str, t0: float, t1: float,
+                 args: Optional[Dict[str, Any]] = None) -> None:
+        """Record a span retroactively from perf_counter stamps — for
+        loop bodies (collect_pool chunks) where a ``with`` per chunk
+        would contort the control flow."""
+        if not self.enabled:
+            return
+        sp = Span(name, args)
+        sp.t0, sp.t1 = t0, t1
+        self._record(sp)
+
+    def instant(self, name: str, args: Optional[Dict[str, Any]] = None
+                ) -> None:
+        """A zero-duration marker event (e.g. ``stall_suspected``)."""
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        with self._lock:
+            if len(self.events) >= self.max_events:
+                self.dropped += 1
+                return
+            self.events.append({
+                "name": name, "ph": "i", "s": "t",
+                "ts": (now - self._origin) * 1e6,
+                "pid": os.getpid(), "tid": threading.get_ident() % 2**31,
+                **({"args": dict(args)} if args else {}),
+            })
+
+    def _record(self, sp: Span) -> None:
+        event = {
+            "name": sp.name, "ph": "X", "cat": "host",
+            "ts": (sp.t0 - self._origin) * 1e6,
+            "dur": (sp.t1 - sp.t0) * 1e6,
+            "pid": os.getpid(), "tid": sp.tid % 2**31,
+        }
+        if sp.args:
+            event["args"] = dict(sp.args)
+        with self._lock:
+            if len(self.events) >= self.max_events:
+                self.dropped += 1
+            else:
+                self.events.append(event)
+
+    # -- export ------------------------------------------------------------
+
+    def export(self, path: str, metadata: Optional[Dict[str, Any]] = None
+               ) -> Optional[str]:
+        """Write Chrome trace-event JSON atomically (tmp + rename), so a
+        reader polling mid-run never sees a torn file.  Returns the path
+        (None when recording is off — nothing to export)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            events = list(self.events)
+            dropped = self.dropped
+        out = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "wall_origin": self._wall_origin,
+                "dropped_events": dropped,
+                **(metadata or {}),
+            },
+        }
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(out, fh)
+        os.replace(tmp, path)
+        return path
+
+
+# The process-wide tracer: disabled (timing-only) until a run installs a
+# recording one (telemetry/runtime.start_run).  phase_timer and the
+# scoring/trainer span sites all route through this, which is exactly
+# what lets one install switch the whole stack.
+_TRACER = SpanTracer(enabled=False)
+
+
+def get_tracer() -> SpanTracer:
+    return _TRACER
+
+
+def set_tracer(tracer: Optional[SpanTracer]) -> SpanTracer:
+    """Install (or, with None, reset to the disabled default) the
+    process-wide tracer; returns the active instance."""
+    global _TRACER
+    _TRACER = tracer if tracer is not None else SpanTracer(enabled=False)
+    return _TRACER

@@ -1,0 +1,180 @@
+"""The ``status`` CLI verb: a live run summary from heartbeat + metrics.
+
+    python -m active_learning_tpu status --log_dir ./logs
+
+Reads what the run writes anyway — ``heartbeat*.json`` (liveness,
+current round/phase/epoch/step) and the tail of ``metrics.jsonl`` (last
+test accuracy, phase wall-clocks, step-time percentiles, throughput) —
+and renders one screen of state.  No jax import, no backend touch: this
+must answer in milliseconds against a wedged run on a loaded host, from
+any shell, including one that could never initialize the run's
+accelerator.
+
+Staleness is judged from the heartbeat file's MTIME against the
+deadline the run embedded in it (``stall_deadline_s``; ``--stale_after``
+overrides) — the same contract an external liveness probe would use.
+
+Exit codes: 0 = alive (or finished), 2 = no heartbeat found,
+3 = stale heartbeat.  ``--json`` emits the machine-readable summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from . import heartbeat as hb_lib
+
+# How much of metrics.jsonl's tail to scan: enough for several rounds of
+# per-epoch telemetry, bounded so a gigabyte stream stays instant.
+_TAIL_BYTES = 256 << 10
+
+
+def get_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m active_learning_tpu status",
+        description="Render a live run summary from heartbeat + metrics")
+    p.add_argument("--log_dir", type=str, default="./logs",
+                   help="the run's --log_dir (holds heartbeat*.json + "
+                        "metrics.jsonl)")
+    p.add_argument("--stale_after", type=float, default=None,
+                   help="staleness deadline in seconds (default: the "
+                        "heartbeat's embedded stall_deadline_s)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    return p
+
+
+def read_metrics_tail(log_dir: str, tail_bytes: int = _TAIL_BYTES
+                      ) -> List[Dict[str, Any]]:
+    """Parsed events from the tail of metrics.jsonl (whole file when it
+    fits).  The first line after a mid-line seek is dropped — it may be
+    torn."""
+    path = os.path.join(log_dir, "metrics.jsonl")
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as fh:
+            if size > tail_bytes:
+                fh.seek(size - tail_bytes)
+                fh.readline()  # partial line
+            raw = fh.read().decode(errors="replace")
+    except OSError:
+        return []
+    events = []
+    for line in raw.splitlines():
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(ev, dict):
+            events.append(ev)
+    return events
+
+
+def _latest_metrics(events: List[Dict[str, Any]],
+                    names: List[str]) -> Dict[str, Any]:
+    """{name: (value, step)} of each metric's LAST occurrence."""
+    out: Dict[str, Any] = {}
+    for ev in events:
+        if ev.get("kind") != "metric":
+            continue
+        for name, value in (ev.get("metrics") or {}).items():
+            if name in names:
+                out[name] = {"value": value, "step": ev.get("step"),
+                             "ts": ev.get("ts")}
+    return out
+
+
+def summarize(log_dir: str, stale_after: Optional[float] = None,
+              now: Optional[float] = None) -> Dict[str, Any]:
+    """The status payload: heartbeats (with per-file staleness), the
+    latest headline metrics, and an overall ok/stale/missing state."""
+    now = time.time() if now is None else now
+    hb_paths = sorted(glob.glob(os.path.join(log_dir, "heartbeat*.json")))
+    heartbeats = []
+    any_stale = False
+    for path in hb_paths:
+        hb = hb_lib.read_heartbeat(path) or {}
+        age = hb_lib.heartbeat_age_s(path, now=now)
+        deadline = (stale_after if stale_after is not None
+                    else float(hb.get("stall_deadline_s", 600.0)))
+        finished = hb.get("status") in ("finished", "crashed")
+        stale = (age is not None and age > deadline and not finished)
+        any_stale = any_stale or stale
+        heartbeats.append({
+            "path": path,
+            "age_s": round(age, 1) if age is not None else None,
+            "deadline_s": deadline,
+            "stale": stale,
+            **{k: hb.get(k) for k in ("status", "round", "phase", "epoch",
+                                      "step", "process_index", "pid",
+                                      "progress")},
+        })
+    events = read_metrics_tail(log_dir)
+    metrics = _latest_metrics(events, [
+        "rd_test_accuracy", "cumulative_budget", "step_time_ms_p50",
+        "step_time_ms_p99", "imgs_per_sec", "pool_rows_per_sec",
+        "train_loss_ema", "grad_norm_ema", "hbm_peak_gb",
+        "jit_cache_miss_delta", "stall_suspected",
+        "rd_query_time", "rd_train_time", "rd_test_time",
+    ])
+    state = ("no-heartbeat" if not heartbeats
+             else "stale" if any_stale else "ok")
+    return {"log_dir": log_dir, "state": state, "heartbeats": heartbeats,
+            "metrics": metrics}
+
+
+def render_text(summary: Dict[str, Any]) -> str:
+    lines = [f"run status: {summary['state'].upper()}  "
+             f"({summary['log_dir']})"]
+    for hb in summary["heartbeats"]:
+        flag = "STALE" if hb["stale"] else (hb.get("status") or "running")
+        where = " ".join(
+            f"{k}={hb[k]}" for k in ("round", "phase", "epoch", "step")
+            if hb.get(k) is not None)
+        age = f"{hb['age_s']}s ago" if hb["age_s"] is not None else "?"
+        proc = (f"p{hb['process_index']}"
+                if hb.get("process_index") is not None else "p0")
+        lines.append(f"  heartbeat[{proc}] {flag:>8}  {age:>12}  {where}")
+    if not summary["heartbeats"]:
+        lines.append("  (no heartbeat*.json — run not started, telemetry "
+                     "off, or wrong --log_dir)")
+    m = summary["metrics"]
+    if m:
+        lines.append("  latest metrics:")
+        for name in ("rd_test_accuracy", "cumulative_budget",
+                     "imgs_per_sec", "step_time_ms_p50",
+                     "step_time_ms_p99", "pool_rows_per_sec",
+                     "train_loss_ema", "grad_norm_ema", "hbm_peak_gb",
+                     "jit_cache_miss_delta", "stall_suspected",
+                     "rd_query_time", "rd_train_time", "rd_test_time"):
+            if name in m:
+                e = m[name]
+                step = f" @step {e['step']}" if e.get("step") is not None \
+                    else ""
+                lines.append(f"    {name:>22} = {e['value']}{step}")
+    else:
+        lines.append("  (no metrics.jsonl events found)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = get_parser().parse_args(argv)
+    summary = summarize(args.log_dir, stale_after=args.stale_after)
+    if args.as_json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(render_text(summary))
+    if summary["state"] == "no-heartbeat":
+        return 2
+    if summary["state"] == "stale":
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

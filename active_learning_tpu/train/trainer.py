@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -34,6 +35,8 @@ from flax import serialization, struct
 
 from ..config import TrainConfig
 from ..data.augment import apply_view
+from ..telemetry import runtime as tele_runtime
+from ..telemetry import spans as tele_spans
 from ..data.core import Dataset
 from ..data.pipeline import (batch_index_lists, iterate_batches,
                              num_batches, padded_batch_layout)
@@ -106,6 +109,13 @@ class Trainer:
         self._train_step = self._build_train_step()
         self._chained_train_step = self._build_chained_train_step()
         self._epoch_scan: Optional[Callable] = None  # built on first use
+        # The generalized jit-compile counter (telemetry/runtime.py): a
+        # no-op unless a run installed telemetry, so unit-test Trainers
+        # never accumulate in a process-global registry.
+        rt = tele_runtime.get_run()
+        rt.register_jit(f"train_step@{id(self):x}", self._train_step)
+        rt.register_jit(f"chained_train_step@{id(self):x}",
+                        self._chained_train_step)
         self._eval_steps: Dict[Any, Callable] = {}
         # ONE device-resident pool cache for the whole experiment, shared
         # between evaluation (here) and acquisition scoring (the Strategy
@@ -209,13 +219,20 @@ class Trainer:
             (loss, new_stats), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params, state.batch_stats, x,
                                        batch["label"], weights)
+            # Telemetry rider: the global gradient norm, computed where
+            # the grads already exist (~|params| FLOPs vs the backward
+            # pass's billions) and fetched in the SAME deferred bulk
+            # materialization as the loss — zero extra device syncs.
+            # Params/opt updates are untouched, so path equality
+            # (tests/test_trainer_parallel.py) is unaffected.
+            gnorm = optax.global_norm(grads)
             updates, new_opt_state = tx.update(grads, state.opt_state,
                                                state.params)
             updates = jax.tree.map(lambda u: -lr * u, updates)
             params = optax.apply_updates(state.params, updates)
             return state.replace(params=params, batch_stats=new_stats,
                                  opt_state=new_opt_state,
-                                 step=state.step + 1), loss
+                                 step=state.step + 1), loss, gnorm
 
         return train_step
 
@@ -233,9 +250,9 @@ class Trainer:
                            donate_argnums=(0, 2))
         def chained(state, batch, key, lr, class_weights, view):
             new_key, sub = jax.random.split(key)
-            new_state, loss = train_step(state, batch, sub, lr,
-                                         class_weights, view=view)
-            return new_state, new_key, loss
+            new_state, loss, gnorm = train_step(state, batch, sub, lr,
+                                                class_weights, view=view)
+            return new_state, new_key, loss, gnorm
 
         return chained
 
@@ -277,19 +294,19 @@ class Trainer:
                     "label": labels[idxs],
                     "mask": mask,
                 }
-                new_state, loss = train_step(state, batch, sub, lr,
-                                             class_weights, view=view)
+                new_state, loss, gnorm = train_step(state, batch, sub, lr,
+                                                    class_weights, view=view)
                 # Bucket-padding steps (v == 0) are fully selected away —
                 # state, key chain, and loss — so the scan is numerically
                 # identical to running exactly the real steps.
                 state = jax.tree.map(
                     lambda n, o: jnp.where(v > 0, n, o), new_state, state)
                 key = jnp.where(v > 0, new_key, key)
-                return (state, key), loss * v
+                return (state, key), (loss * v, gnorm * v)
 
-            (state, key), losses = jax.lax.scan(
+            (state, key), (losses, gnorms) = jax.lax.scan(
                 body, (state, key), (idx_mat, mask_mat, valid))
-            return state, key, losses
+            return state, key, losses, gnorms
 
         return epoch_scan
 
@@ -354,6 +371,56 @@ class Trainer:
         valid = np.zeros(steps, dtype=np.float32)
         valid[:steps_real] = 1.0
         return idx_mat, mask_mat, valid, steps_real
+
+    # -- per-epoch telemetry ----------------------------------------------
+
+    # EMA smoothing for the loss/grad-norm telemetry series (per-epoch
+    # cadence; ~10-epoch effective window).
+    TELEMETRY_EMA_ALPHA = 0.2
+
+    @staticmethod
+    def _emit_epoch_telemetry(metric_cb, round_idx: int, epoch: int,
+                              n_epoch: int, n_images: int,
+                              dispatch_wall: float, synced_wall: float,
+                              synced: bool, steps: int,
+                              step_times: List[float]) -> None:
+        """Step-time p50/p99 and imgs/sec for one epoch, through the
+        caller's metric sink — with nothing dishonest on async backends
+        (jax dispatch returns before the device finishes, and this path
+        deliberately adds NO device sync of its own):
+
+          * host-batched path (``step_times`` non-empty): loop-cadence
+            percentiles — each delta spans gather + dispatch, and the
+            donated-buffer backpressure makes steady-state cadence track
+            real step time;
+          * epoch-scan path (ONE dispatch per epoch): the only honest
+            anchor is the validation fetch that follows the scan, so the
+            per-step mean is derived from the SYNCED train+val wall
+            (p50 == p99 labels it as a mean; slightly over-counting val
+            beats under-counting the scan by orders of magnitude);
+          * epoch-scan without early stopping: no sync exists anywhere
+            in the epoch — nothing trustworthy to emit, so nothing is.
+
+        Step axis: the same round-folded epoch counter set_epoch uses,
+        so multi-round runs keep a monotonic x-axis."""
+        if metric_cb is None or steps <= 0:
+            return
+        from ..telemetry.runtime import percentile
+        if step_times:
+            p50 = percentile(step_times, 0.50)
+            p99 = percentile(step_times, 0.99)
+            wall = dispatch_wall
+        elif synced:
+            wall = synced_wall
+            p50 = p99 = wall / steps
+        else:
+            return
+        if wall <= 0:
+            return
+        tele_step = round_idx * (n_epoch + 1) + epoch
+        metric_cb("step_time_ms_p50", round(p50 * 1000.0, 3), tele_step)
+        metric_cb("step_time_ms_p99", round(p99 * 1000.0, 3), tele_step)
+        metric_cb("imgs_per_sec", round(n_images / wall, 1), tele_step)
 
     # -- class weights ---------------------------------------------------
 
@@ -485,6 +552,8 @@ class Trainer:
                 train_set, labeled_idxs, bs)
             if self._epoch_scan is None:
                 self._epoch_scan = self._build_epoch_scan()
+                tele_runtime.get_run().register_jit(
+                    f"epoch_scan@{id(self):x}", self._epoch_scan)
 
         best_perf, best_epoch, es_count = 0.0, 0, 0
         best_variables = None  # device tree after an improvement this fit
@@ -563,9 +632,19 @@ class Trainer:
                     f"Resuming round {round_idx} training from epoch "
                     f"{start_epoch} (mid-round fit state)")
 
+        # Per-step/per-epoch telemetry (DESIGN.md §7).  ``collect`` False
+        # (no run installed, or telemetry off) must add NO per-step work:
+        # every perf_counter call and list append below is gated on it.
+        rt = tele_runtime.get_run()
+        tracer = tele_spans.get_tracer()
+        collect = rt.train_metrics
+        n_real = len(labeled_idxs)
+
         epochs_run = 0
         for epoch in range(start_epoch, n_epoch + 1):
             epochs_run = epoch
+            t_epoch0 = time.perf_counter() if collect else 0.0
+            step_times: List[float] = []
             if hasattr(train_set, "set_epoch"):
                 # Advance disk datasets' per-(seed, epoch, index) crop RNG
                 # (data/imagenet.py); fold the round in so AL rounds don't
@@ -583,13 +662,16 @@ class Trainer:
             if use_dr:
                 idx_mat, mask_mat, valid, steps_real = \
                     self._epoch_index_matrix(len(labeled_idxs), bs, rng)
-                state, key, losses = self._epoch_scan(
+                state, key, losses, gnorms = self._epoch_scan(
                     state, dr_images, dr_labels, jnp.asarray(idx_mat),
                     jnp.asarray(mask_mat), jnp.asarray(valid), key, lr,
                     class_weights, view=train_set.view)
                 epoch_loss = jnp.sum(losses) / steps_real
+                epoch_gnorm = jnp.sum(gnorms) / steps_real
+                steps_run = steps_real
             else:
-                losses = []
+                losses, gnorms = [], []
+                t_step = time.perf_counter() if collect else 0.0
                 # Host-side s2d only without a batch_hook: VAAL's hook
                 # feeds the same sharded batch to its 3-channel VAE.
                 for batch in iterate_batches(
@@ -599,18 +681,36 @@ class Trainer:
                         local=mesh_lib.process_local_rows(self.mesh, bs),
                         s2d=self._host_s2d and batch_hook is None):
                     sharded = mesh_lib.shard_batch(batch, self.mesh)
-                    state, key, loss = self._chained_train_step(
+                    state, key, loss, gnorm = self._chained_train_step(
                         state, sharded, key, lr, class_weights,
                         view=train_set.view)
                     losses.append(loss)
+                    gnorms.append(gnorm)
                     if batch_hook is not None:
                         # Receives the already-sharded device batch — no
                         # second host->device transfer on the hot path.
                         batch_hook(epoch, sharded)
+                    if collect:
+                        # Loop-cadence deltas (gather + dispatch; the
+                        # donated-buffer backpressure makes steady-state
+                        # cadence track real step time) — host-side, no
+                        # sync.
+                        now = time.perf_counter()
+                        step_times.append(now - t_step)
+                        t_step = now
+                        rt.tick(epoch=epoch, step=len(losses))
                 epoch_loss = (jnp.mean(jnp.stack(losses))
                               if losses else 0.0)
+                epoch_gnorm = (jnp.mean(jnp.stack(gnorms))
+                               if gnorms else 0.0)
+                steps_run = len(losses)
             record = {"epoch": epoch, "lr": float(lr),
-                      "train_loss": epoch_loss}
+                      "train_loss": epoch_loss, "grad_norm": epoch_gnorm}
+            if collect:
+                t_train_end = time.perf_counter()
+                tracer.complete("epoch", t_epoch0, t_train_end,
+                                args={"round": round_idx, "epoch": epoch,
+                                      "steps": steps_run})
 
             if use_es:
                 perf = self.evaluate(state, al_set, eval_idxs)
@@ -663,6 +763,16 @@ class Trainer:
                     ckpt_lib.save_variables(weight_paths["current_ckpt"],
                                             jax.tree.map(np.asarray,
                                                          state.variables))
+            if collect:
+                # AFTER validation on purpose: on the epoch-scan path the
+                # eval-accuracy fetch above is the sync that makes the
+                # epoch wall real (see _emit_epoch_telemetry).
+                self._emit_epoch_telemetry(
+                    metric_cb, round_idx, epoch, n_epoch, n_real,
+                    t_train_end - t_epoch0,
+                    time.perf_counter() - t_epoch0, use_es,
+                    steps_run, step_times)
+                rt.tick(epoch=epoch)
             history.append(record)
             if use_es and es_count > es_patience:
                 # Break BEFORE the periodic fit-state save: a state whose
@@ -704,10 +814,23 @@ class Trainer:
             multihost_utils.sync_global_devices("fit_ckpts_written")
         self.logger.info(
             f"Sanity Check: Best ckpt occurs on epoch {best_epoch}")
+        ema_loss = ema_gnorm = None
         for rec in history:
             # Deferred train-loss fetch (see the epoch loop): one bulk
             # materialization here instead of one host sync per epoch.
+            # The loss/grad-norm EMAs piggyback on this SAME fetch — the
+            # telemetry rider costs no additional device sync.
             rec["train_loss"] = float(rec["train_loss"])
+            rec["grad_norm"] = float(rec.get("grad_norm", 0.0))
+            if collect and metric_cb is not None:
+                a = self.TELEMETRY_EMA_ALPHA
+                ema_loss = (rec["train_loss"] if ema_loss is None
+                            else a * rec["train_loss"] + (1 - a) * ema_loss)
+                ema_gnorm = (rec["grad_norm"] if ema_gnorm is None
+                             else a * rec["grad_norm"] + (1 - a) * ema_gnorm)
+                tele_step = round_idx * (n_epoch + 1) + rec["epoch"]
+                metric_cb("train_loss_ema", round(ema_loss, 6), tele_step)
+                metric_cb("grad_norm_ema", round(ema_gnorm, 6), tele_step)
         return FitResult(state=state, best_epoch=best_epoch,
                          best_perf=best_perf, epochs_run=epochs_run,
                          history=history)

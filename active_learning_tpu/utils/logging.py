@@ -36,8 +36,13 @@ def setup_logging(directory: str, filename: str) -> logging.Logger:
         logger.removeHandler(h)
     formatter = MillisecondFormatter(
         fmt="%(asctime)s %(message)s", datefmt="%Y-%m-%d,%H:%M:%S.%f")
+    # Append when the log already exists (an experiment RESUME reuses its
+    # exp_hash-derived filename — truncating here erased every prior
+    # round's log lines); truncate only a genuinely fresh file.  The "w"
+    # spelling keeps fresh-run behavior byte-identical.
+    path = os.path.join(directory, filename)
     file_handler = logging.FileHandler(
-        filename=os.path.join(directory, filename), mode="w+")
+        filename=path, mode="a" if os.path.exists(path) else "w")
     file_handler.setFormatter(formatter)
     logger.addHandler(file_handler)
     console_handler = logging.StreamHandler()

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import uuid
 from typing import Any, Dict, Iterable, Optional
@@ -76,11 +77,16 @@ class JsonlSink(MetricsSink):
         self.experiment_key = experiment_key or uuid.uuid4().hex[:9]
         self._path = os.path.join(directory, "metrics.jsonl")
         self._fh = open(self._path, "a")
+        # The telemetry watchdog emits ``stall_suspected`` from its own
+        # thread; interleaved writes must stay line-atomic.
+        self._lock = threading.Lock()
 
     def _emit(self, event: Dict[str, Any]) -> None:
         event["ts"] = time.time()
-        self._fh.write(json.dumps(event, default=_json_default) + "\n")
-        self._fh.flush()
+        line = json.dumps(event, default=_json_default) + "\n"
+        with self._lock:
+            self._fh.write(line)
+            self._fh.flush()
 
     def log_parameters(self, params):
         self._emit({"kind": "params", "params": params})
@@ -135,6 +141,9 @@ class CsvSink(MetricsSink):
         self._writer = csv.writer(self._fh)
         if new:
             self._writer.writerow(["name", "value", "step", "ts"])
+        # The telemetry watchdog emits from its own thread (same
+        # discipline as JsonlSink): rows must stay line-atomic.
+        self._lock = threading.Lock()
 
     def log_parameters(self, params):
         with open(os.path.join(self.directory, "params.json"), "w") as fh:
@@ -142,9 +151,10 @@ class CsvSink(MetricsSink):
 
     def log_metrics(self, metrics, step=None):
         ts = time.time()
-        for name, value in metrics.items():
-            self._writer.writerow([name, _to_float(value), step, ts])
-        self._fh.flush()
+        with self._lock:
+            for name, value in metrics.items():
+                self._writer.writerow([name, _to_float(value), step, ts])
+            self._fh.flush()
 
     def log_asset(self, name, data):
         with open(os.path.join(self.directory, "assets", f"{name}.txt"),
@@ -172,20 +182,38 @@ class TensorBoardSink(MetricsSink):
         self.experiment_key = experiment_key or uuid.uuid4().hex[:9]
         self._writer = SummaryWriter(
             os.path.join(directory, "tb", self.experiment_key))
-        self._auto_step = 0
+        self._auto_steps: Dict[str, int] = {}
+        # SummaryWriter is not documented thread-safe and the per-name
+        # auto-step counter certainly is not; the watchdog thread emits
+        # through the same sink as the main loop.
+        self._lock = threading.Lock()
 
     def log_parameters(self, params):
         text = "\n".join(f"    {k}: {v}" for k, v in sorted(params.items()))
         self._writer.add_text("parameters", text)
 
+    def _next_step(self, name: str) -> int:
+        # PER-NAME auto-step: a single shared counter incremented once
+        # per log_metrics call scrambled every series' x-axis as soon as
+        # two call sites omitted ``step`` (each name only saw a sparse,
+        # drifting subset of the shared sequence).  Each series now
+        # advances its own 1, 2, 3, ...
+        if not hasattr(self, "_auto_steps"):  # __new__-built test fakes
+            self._auto_steps = {}
+        nxt = self._auto_steps.get(name, 0) + 1
+        self._auto_steps[name] = nxt
+        return nxt
+
     def log_metrics(self, metrics, step=None):
-        if step is None:
-            self._auto_step += 1
-        for name, value in metrics.items():
-            self._writer.add_scalar(
-                name, _to_float(value),
-                global_step=self._auto_step if step is None else step)
-        self._writer.flush()
+        if not hasattr(self, "_lock"):  # __new__-built test fakes
+            self._lock = threading.Lock()
+        with self._lock:
+            for name, value in metrics.items():
+                self._writer.add_scalar(
+                    name, _to_float(value),
+                    global_step=(self._next_step(name) if step is None
+                                 else step))
+            self._writer.flush()
 
     def log_asset(self, name, data):
         with open(os.path.join(self.directory, "assets", f"{name}.txt"),

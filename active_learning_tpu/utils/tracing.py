@@ -1,20 +1,24 @@
-"""Tracing and profiling.
+"""Tracing and profiling — a thin shim over telemetry/spans.
 
 The reference's only tracing is ad-hoc ``time()`` deltas printed per phase
 (src/main_al.py:160-178) and per-batch loss prints (strategy.py:274-279).
-Here (SURVEY.md §5): the same per-phase wall-clock timers feed the metrics
-sink (experiment/driver.py), each phase is additionally wrapped in a
-``jax.profiler.TraceAnnotation`` so device traces show query/train/test
-spans, and an opt-in ``profile_dir`` captures a full XLA profiler trace
-(viewable in TensorBoard/XProf) for the whole run.
+Here the same per-phase timers are HOST SPANS (telemetry/spans.py): one
+measurement feeds the ``rd_{name}`` metric, the log line, the Chrome
+trace event, and the heartbeat tick, so the trace can never silently
+fork from the metrics (scripts/trace_lint.py asserts this routing).
+Each phase additionally wraps a ``jax.profiler.TraceAnnotation`` so
+device traces show query/train/test spans, and an opt-in
+``profile_dir`` captures a full XLA profiler trace (TensorBoard/XProf)
+for the whole run.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
 from typing import Iterator, Optional
 
+from ..telemetry import runtime as _tele_runtime
+from ..telemetry import spans as _tele_spans
 from .logging import get_logger
 
 
@@ -32,12 +36,15 @@ def phase_timer(name: str, round_idx: int, sink=None,
                 logger=None) -> Iterator[None]:
     """Wall-clock a phase, log it, and emit ``rd_{name}`` to the metrics
     sink — the reference's per-phase prints (main_al.py:160-178) with the
-    profiler annotation added."""
+    profiler annotation added.  The timing IS the host span's: metric,
+    log, trace event, and heartbeat all read one measurement."""
     logger = logger or get_logger()
-    start = time.time()
-    with annotate(f"{name}/rd{round_idx}"):
-        yield
-    seconds = time.time() - start
+    _tele_runtime.get_run().tick(force=True, phase=name, round=round_idx)
+    with _tele_spans.get_tracer().span(
+            name, args={"round": round_idx}) as sp:
+        with annotate(f"{name}/rd{round_idx}"):
+            yield
+    seconds = sp.duration_s
     logger.info(f"Rd {round_idx} {name} is {seconds:.3f}s")
     if sink is not None:
         sink.log_metric(f"rd_{name}", seconds, step=round_idx)

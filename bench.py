@@ -995,10 +995,17 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
     # default dir already holds entries decides if this run's "cold"
     # round 0 pays real compiles or warm disk hits — recorded so the
     # cold-warm compile-tax gap is attributable across bench rounds.
+    # The driver gates the DEFAULT cache off on CPU (donated-buffer
+    # corruption in cache-deserialized executables); mirror that gate so
+    # a CPU smoke run with a leftover non-empty dir is not misreported
+    # as cache-warm while the child actually ran uncached.
+    from active_learning_tpu.experiment.driver import _platform_is_cpu
     xla_cache_dir = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
                      or os.path.join(os.path.expanduser("~"), ".cache",
                                      "al_tpu_xla_cache"))
-    cache_prewarmed = bool(os.path.isdir(xla_cache_dir)
+    cache_enabled = bool(os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                         or not _platform_is_cpu())
+    cache_prewarmed = bool(cache_enabled and os.path.isdir(xla_cache_dir)
                            and os.listdir(xla_cache_dir))
     log(f"[al_round_{config}] {model_name} x{n_chips} {device_kind}, "
         f"budget {budget}, {epochs} epochs, 2 rounds "
@@ -1029,6 +1036,15 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
             if k == f"rd_{name}" and step == rd:
                 return round(v, 2)
         return None
+
+    def step_pct(name):
+        # The driver's per-epoch telemetry (trainer._emit_epoch_telemetry)
+        # on the WARM round only: its step axis is round*(epochs+1)+epoch,
+        # so round 1 is strictly past epochs+1.  Median over the round's
+        # epochs — one number per phase for the bench line.
+        vals = sorted(v for k, v, s in sink.metrics
+                      if k == name and s is not None and s > epochs + 1)
+        return round(vals[len(vals) // 2], 3) if vals else None
 
     names = ("query_time", "init_network_weights_time", "train_time",
              "load_best_ckpt_time", "test_time")
@@ -1062,7 +1078,13 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         # does not (XLA compiles dominate it).  The persistent compile
         # cache + shape bucketing exist to shrink this gap.
         "compile_tax_sec": round(cold - warm, 2),
+        "compile_cache_enabled": cache_enabled,
         "compile_cache_prewarmed": cache_prewarmed,
+        # Warm-round step-time percentiles from the driver's own
+        # per-epoch telemetry stream (the run-wide telemetry subsystem
+        # measuring a real driver loop, not a bench-only timer).
+        "step_time_ms_p50": step_pct("step_time_ms_p50"),
+        "step_time_ms_p99": step_pct("step_time_ms_p99"),
         "total_sec": round(total_sec, 1),
         "residency": residency,
         **_model_config_fields(strategy.model),
@@ -1155,21 +1177,62 @@ def _flops_per_step(jitted, phase: str, *args, **kwargs):
         return None
 
 
-def _time_loop(step_once, sync, iters: int, warmup: int = 3) -> float:
+def _time_loop(step_once, sync, iters: int, warmup: int = 3,
+               step_times=None) -> float:
     """The ONE timing discipline for every measured step — primary and
     alt-batch, train and score: ``warmup`` untimed iterations, a
     data-dependent host fetch (``sync``) so the device really finished,
     then ``iters`` timed iterations closed by the same fetch
     (block_until_ready can return early on remote-execution backends;
-    host fetches cannot)."""
+    host fetches cannot).  ``step_times`` (a list) collects the per-
+    iteration host deltas for the step-time percentiles — see
+    _step_percentiles for when those deltas are trustworthy."""
     for _ in range(warmup):
         step_once()
     sync()
     t0 = time.perf_counter()
+    prev = t0
     for _ in range(iters):
         step_once()
+        if step_times is not None:
+            now = time.perf_counter()
+            step_times.append(now - prev)
+            prev = now
     sync()
     return time.perf_counter() - t0
+
+
+def _pctile(vals, q: float):
+    """Nearest-rank percentile (the serve/metrics + telemetry
+    convention, re-spelled here so the bench child stays importable
+    without the package)."""
+    if not vals:
+        return None
+    vals = sorted(vals)
+    return float(vals[min(len(vals) - 1,
+                          max(0, int(round(q * (len(vals) - 1)))))])
+
+
+def _step_percentiles(result: dict, step_times, dt: float,
+                      iters: int) -> None:
+    """step_time_ms_p50/p99 onto a phase result.  Host-side per-
+    iteration deltas are real step cadence only while the dispatch queue
+    backpressures (donated buffers + data-dependent chaining do this in
+    steady state); when the host ran far ahead (sum of deltas << the
+    synced wall time — fully async backend), percentiles degrade to the
+    loop average and say so in step_time_source."""
+    if iters <= 0 or dt <= 0:
+        return
+    if step_times and sum(step_times) >= 0.8 * dt:
+        result["step_time_ms_p50"] = round(
+            _pctile(step_times, 0.50) * 1000, 3)
+        result["step_time_ms_p99"] = round(
+            _pctile(step_times, 0.99) * 1000, 3)
+        result["step_time_source"] = "host-cadence"
+    else:
+        result["step_time_ms_p50"] = result["step_time_ms_p99"] = round(
+            dt / iters * 1000, 3)
+        result["step_time_source"] = "loop-average"
 
 
 def _train_runner(trainer, batch, state, n_classes, view, seed: int):
@@ -1187,8 +1250,9 @@ def _train_runner(trainer, batch, state, n_classes, view, seed: int):
     h = {"state": state, "key": jax.random.PRNGKey(seed), "loss": None}
 
     def step_once():
-        h["state"], h["key"], h["loss"] = trainer._chained_train_step(
-            h["state"], batch, h["key"], lr, cw, view=view)
+        h["state"], h["key"], h["loss"], h["gnorm"] = \
+            trainer._chained_train_step(
+                h["state"], batch, h["key"], lr, cw, view=view)
 
     return step_once, (lambda: float(h["loss"])), h
 
@@ -1292,13 +1356,16 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         _time_loop(step_once, sync, 0, warmup=3)
         jax.profiler.start_trace(os.path.join(profile_dir, phase))
         try:
-            dt = _time_loop(step_once, sync, iters, warmup=0)
+            step_times = []
+            dt = _time_loop(step_once, sync, iters, warmup=0,
+                            step_times=step_times)
         finally:
             jax.profiler.stop_trace()
         log(f"[{phase}] profiler trace written to "
             f"{os.path.join(profile_dir, phase)}")
     else:
-        dt = _time_loop(step_once, sync, iters)
+        step_times = []
+        dt = _time_loop(step_once, sync, iters, step_times=step_times)
 
     ips = batch_size * iters / dt
     result = {
@@ -1312,6 +1379,7 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         "platform": jax.devices()[0].platform,
         **_model_config_fields(model),
     }
+    _step_percentiles(result, step_times, dt, iters)
     if profile_dir:
         result["profiled"] = True  # trace overhead in dt: never cached
     yield dict(result)  # the measurement is safe with the parent now
@@ -1725,6 +1793,8 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                          ("qps_closed", "qps"),
                          ("p99_ms_closed", "p99_ms"),
                          ("request_path_compiles", "req_compiles"),
+                         ("step_time_ms_p50", "step_time_ms_p50"),
+                         ("step_time_ms_p99", "step_time_ms_p99"),
                          ("backend", "be")):
             if e.get(src) is not None:
                 c[dst] = e[src]

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""trace_lint: spans and phase metrics must be ONE measurement.
+
+The telemetry design (DESIGN.md §7) hangs on a single invariant: every
+phase timer routes through the span tracer, so the Chrome trace and the
+``rd_{name}`` metrics can never silently fork — a phase that appears in
+metrics.jsonl but not in trace.json (or with a different duration)
+would make the trace unusable as evidence.  This lint enforces the
+routing statically, invoked from tier-1 (tests/test_telemetry.py):
+
+  1. ``utils/tracing.phase_timer`` itself must open a tracer span and
+     derive its reported seconds FROM that span (not a second clock).
+  2. Nobody else may define a ``phase_timer`` (a fork would bypass the
+     tracer while keeping the metric name).
+  3. Every module calling ``phase_timer(`` must import it from
+     ``utils.tracing`` — no copies, no local re-implementations.
+  4. ``jax.profiler.TraceAnnotation`` stays behind ``tracing.annotate``
+     (one device-naming convention; the whitelist is tracing.py).
+
+Stdlib only; exits 0 clean / 1 with findings on stderr.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "active_learning_tpu")
+TRACING = os.path.join(PKG, "utils", "tracing.py")
+
+# The one module allowed to touch jax.profiler.TraceAnnotation directly.
+ANNOTATION_WHITELIST = {TRACING}
+
+
+def _py_files():
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in files:
+            if name.endswith(".py"):
+                yield os.path.join(root, name)
+    yield os.path.join(REPO, "bench.py")
+    scripts = os.path.join(REPO, "scripts")
+    if os.path.isdir(scripts):
+        for name in os.listdir(scripts):
+            if name.endswith(".py") and name != "trace_lint.py":
+                yield os.path.join(scripts, name)
+
+
+def _imports_phase_timer_from_tracing(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.endswith("tracing") and any(
+                    a.name == "phase_timer" for a in node.names):
+                return True
+    return False
+
+
+def check() -> list:
+    problems = []
+
+    # 1. The shim itself routes through the tracer.
+    with open(TRACING) as fh:
+        tracing_src = fh.read()
+    timer_body = tracing_src.split("def phase_timer", 1)
+    if len(timer_body) != 2:
+        problems.append(f"{TRACING}: phase_timer not found")
+        timer_src = ""
+    else:
+        # Up to the next top-level def.
+        timer_src = re.split(r"\n@|\ndef ", timer_body[1], maxsplit=1)[0]
+    if ".span(" not in timer_src:
+        problems.append(
+            f"{TRACING}: phase_timer does not open a tracer span — "
+            "phase metrics would fork from the trace")
+    if "duration_s" not in timer_src:
+        problems.append(
+            f"{TRACING}: phase_timer does not take its seconds from the "
+            "span (two clocks = metric/trace drift)")
+
+    for path in _py_files():
+        rel = os.path.relpath(path, REPO)
+        with open(path) as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            problems.append(f"{rel}: unparseable ({e})")
+            continue
+
+        # 2. No competing phase_timer definitions.
+        if path != TRACING:
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node.name == "phase_timer":
+                    problems.append(
+                        f"{rel}:{node.lineno}: defines its own "
+                        "phase_timer — route through utils.tracing")
+
+        # 3. Call sites import the shim.
+        calls = [n for n in ast.walk(tree)
+                 if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Name)
+                 and n.func.id == "phase_timer"]
+        if calls and path != TRACING \
+                and not _imports_phase_timer_from_tracing(tree):
+            problems.append(
+                f"{rel}:{calls[0].lineno}: calls phase_timer without "
+                "importing it from utils.tracing")
+
+        # 4. Device annotations stay behind tracing.annotate (AST-level:
+        # docstring mentions are fine, attribute uses are not).
+        if path not in ANNOTATION_WHITELIST:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "TraceAnnotation":
+                    problems.append(
+                        f"{rel}:{node.lineno}: uses jax.profiler."
+                        "TraceAnnotation directly — use utils.tracing."
+                        "annotate so device spans keep one naming "
+                        "convention")
+
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"trace_lint: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("trace_lint: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,10 +21,16 @@ import jax  # noqa: E402
 # update wins as long as no backend has been initialized yet.
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: repeated test runs skip XLA recompiles.
-_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# NO persistent compilation cache in tests.  jax 0.4.37's CPU backend
+# corrupts donated buffers when an executable is DESERIALIZED from the
+# persistent cache (minimal repro: a donate_argnums jit over a replicated
+# sharding, compiled once then re-jitted in the same process, dies with
+# `free(): corrupted unsorted chunks` — or silently trains on garbage).
+# This was the root cause of the "flaky" mid-round-resume failures: the
+# resumed fit's freshly-jitted train step got a cache hit and its donated
+# state buffers were reused while still referenced.  The production
+# driver gates the cache off on CPU for the same reason
+# (experiment/driver.enable_compilation_cache).
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -81,6 +81,12 @@ class TestDegradedModeLine:
                 "ips": 2655.3, "ips_per_chip": 2655.3, "mfu": 0.322,
                 "n_chips": 1, "device_kind": "cpu", "platform": "cpu",
                 "batch_per_chip": 128,
+                # The telemetry-era per-phase step-time percentiles
+                # (bench._step_percentiles / the driver's per-epoch
+                # telemetry for al_round phases) must ride the compact
+                # line under their canonical names.
+                "step_time_ms_p50": 48.2, "step_time_ms_p99": 61.7,
+                "step_time_source": "host-cadence",
                 "captured_utc": "2026-01-01T00:00:00Z",
             }
         }
@@ -94,6 +100,9 @@ class TestDegradedModeLine:
         phase = out["phases"]["resnet50_imagenet_train"]
         assert phase["cached"] is True and phase["ips"] == \
             pytest.approx(2655.3)
+        # The degraded-mode line carries the step-time percentiles.
+        assert phase["step_time_ms_p50"] == pytest.approx(48.2)
+        assert phase["step_time_ms_p99"] == pytest.approx(61.7)
 
     def test_state_dir_redirect_leaves_repo_files_alone(self, tmp_path):
         """The redirect itself: nothing in the repo root may be touched

@@ -132,9 +132,18 @@ class TestCompilationCacheConfig:
         from active_learning_tpu.experiment import driver
 
         target = str(tmp_path / "xla_cache")
-        got = driver.enable_compilation_cache(target)
-        assert got == target
-        assert jax.config.jax_compilation_cache_dir == target
+        old = jax.config.jax_compilation_cache_dir
+        try:
+            got = driver.enable_compilation_cache(target)
+            assert got == target
+            assert jax.config.jax_compilation_cache_dir == target
+        finally:
+            # Undo the process-wide config leak: the rest of the session
+            # must keep running cache-less — jax 0.4.37's CPU backend
+            # corrupts donated buffers in cache-DESERIALIZED executables
+            # (see conftest.py), so a leaked cache dir here could make
+            # any later donating jit nondeterministic.
+            jax.config.update("jax_compilation_cache_dir", old)
 
     def test_empty_string_disables(self):
         from active_learning_tpu.experiment import driver

@@ -1,0 +1,588 @@
+"""Run-wide telemetry (active_learning_tpu/telemetry/, DESIGN.md §7):
+span nesting + Chrome-trace validity, heartbeat atomicity + staleness,
+the watchdog on a frozen fake clock, Prometheus exposition, the
+telemetry-off no-per-step-work contract, the status verb, trace_lint,
+and the end-to-end CPU-mesh smoke run the acceptance criteria pin."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from active_learning_tpu.telemetry import heartbeat as hb_lib
+from active_learning_tpu.telemetry import prom as prom_lib
+from active_learning_tpu.telemetry import runtime as rt_lib
+from active_learning_tpu.telemetry import spans as spans_lib
+from active_learning_tpu.telemetry import status as status_lib
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+class TestSpanTracer:
+    def test_nesting_and_chrome_trace_validity(self, tmp_path):
+        tracer = spans_lib.SpanTracer(enabled=True)
+        with tracer.span("experiment", args={"exp": "t"}):
+            assert tracer.depth() == 1
+            for rd in range(2):
+                with tracer.span("round", args={"round": rd}):
+                    with tracer.span("train_time", args={"round": rd}):
+                        with tracer.span("epoch", args={"epoch": 1}):
+                            assert tracer.depth() == 4
+        assert tracer.depth() == 0
+        path = str(tmp_path / "trace.json")
+        assert tracer.export(path) == path
+
+        with open(path) as fh:
+            trace = json.load(fh)  # strict JSON
+        events = trace["traceEvents"]
+        assert {e["name"] for e in events} == {"experiment", "round",
+                                               "train_time", "epoch"}
+        for e in events:
+            assert e["ph"] == "X"
+            assert isinstance(e["ts"], float) and isinstance(e["dur"],
+                                                             float)
+            assert e["dur"] >= 0 and "pid" in e and "tid" in e
+        # Interval nesting: every child lies inside its parent's span.
+        by_name = {e["name"]: e for e in events}
+        exp = by_name["experiment"]
+        for name in ("round", "train_time", "epoch"):
+            child = by_name[name]
+            assert child["ts"] >= exp["ts"] - 1e-6
+            assert (child["ts"] + child["dur"]
+                    <= exp["ts"] + exp["dur"] + 1e-6)
+
+    def test_disabled_tracer_still_times_but_records_nothing(self):
+        tracer = spans_lib.SpanTracer(enabled=False)
+        with tracer.span("phase") as sp:
+            time.sleep(0.01)
+        assert sp.duration_s >= 0.01
+        assert tracer.events == []
+
+    def test_complete_and_instant_and_cap(self, tmp_path):
+        tracer = spans_lib.SpanTracer(enabled=True, max_events=2)
+        t0 = time.perf_counter()
+        tracer.complete("chunk", t0, t0 + 0.5, args={"rows": 32})
+        tracer.instant("stall_suspected", args={"stalled_s": 3.0})
+        tracer.complete("chunk", t0, t0 + 1.0)  # over the cap: dropped
+        assert len(tracer.events) == 2 and tracer.dropped == 1
+        path = str(tmp_path / "t.json")
+        tracer.export(path)
+        with open(path) as fh:
+            out = json.load(fh)
+        assert out["otherData"]["dropped_events"] == 1
+
+    def test_thread_safety_of_event_buffer(self):
+        tracer = spans_lib.SpanTracer(enabled=True)
+
+        def worker():
+            for _ in range(200):
+                with tracer.span("w"):
+                    pass
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(tracer.events) == 800
+
+
+class TestHeartbeat:
+    def test_tick_writes_atomic_json_and_rate_limits(self, tmp_path):
+        path = str(tmp_path / "heartbeat.json")
+        clock = {"t": 100.0}
+        hb = hb_lib.HeartbeatWriter(path, every_s=5.0,
+                                    stall_deadline_s=60.0,
+                                    monotonic_fn=lambda: clock["t"])
+        assert hb.tick(round=0, phase="query") is True
+        first = hb_lib.read_heartbeat(path)
+        assert first["round"] == 0 and first["phase"] == "query"
+        assert first["progress"] == 1
+        assert first["stall_deadline_s"] == 60.0
+        # Within the cadence: progress advances, file does not.
+        clock["t"] += 1.0
+        assert hb.tick(round=0, phase="train", epoch=3) is False
+        assert hb_lib.read_heartbeat(path)["phase"] == "query"
+        assert hb.progress == 2
+        # force=True (phase transitions) writes regardless.
+        assert hb.tick(force=True, phase="test") is True
+        now = hb_lib.read_heartbeat(path)
+        assert now["phase"] == "test" and now["epoch"] == 3
+        # No torn temp files left behind.
+        assert [f for f in os.listdir(tmp_path)
+                if f.startswith("heartbeat.json.tmp")] == []
+
+    def test_staleness_from_mtime_vs_embedded_deadline(self, tmp_path):
+        path = str(tmp_path / "heartbeat.json")
+        hb = hb_lib.HeartbeatWriter(path, every_s=0.0,
+                                    stall_deadline_s=30.0)
+        hb.tick(round=1)
+        assert hb_lib.is_stale(path) is False
+        # Age the FILE (the mtime is the contract, not the payload ts).
+        old = time.time() - 100.0
+        os.utime(path, (old, old))
+        assert hb_lib.is_stale(path) is True          # 100s > 30s
+        assert hb_lib.is_stale(path, deadline_s=1000.0) is False
+        assert hb_lib.is_stale(str(tmp_path / "absent.json")) is None
+        age = hb_lib.heartbeat_age_s(path)
+        assert age == pytest.approx(100.0, abs=5.0)
+
+    def test_watchdog_fires_once_per_stall_on_fake_clock(self, tmp_path):
+        clock = {"t": 0.0}
+        hb = hb_lib.HeartbeatWriter(str(tmp_path / "hb.json"), every_s=0.0,
+                                    monotonic_fn=lambda: clock["t"])
+        stalls = []
+        wd = hb_lib.StallWatchdog(hb, deadline_s=10.0,
+                                  on_stall=stalls.append,
+                                  monotonic_fn=lambda: clock["t"])
+        hb.tick(round=0)
+        clock["t"] = 5.0
+        assert wd.check() is False          # under the deadline
+        clock["t"] = 11.0
+        assert wd.check() is False          # progress moved at t=0... still
+        clock["t"] = 12.0
+        hb.tick(round=0)                    # progress resumes
+        assert wd.check() is False
+        clock["t"] = 23.0                   # frozen 11s > 10s deadline
+        assert wd.check() is True
+        assert len(stalls) == 1 and stalls[0] > 10.0
+        clock["t"] = 40.0                   # STILL stalled: no re-fire
+        assert wd.check() is False
+        hb.tick(round=1)                    # progress re-arms
+        clock["t"] = 41.0
+        assert wd.check() is False
+        clock["t"] = 60.0
+        assert wd.check() is True           # second episode fires again
+        assert wd.stalls_detected == 2
+
+
+class TestPrometheus:
+    def test_render_parses_and_round_trips(self):
+        text = prom_lib.render([
+            ("al_run_round", None, 3),
+            ("al_serve_requests_total", {"endpoint": "/v1/score"}, 17),
+            ("al_serve_requests_total", {"endpoint": "/v1/predict"}, 4),
+            ("al_serve_request_latency_ms", {"quantile": "0.99"}, 12.75),
+            ("weird-name.with dots", None, 1.5),
+            ("dropped_none", None, None),
+            ("bool_gauge", None, True),
+        ])
+        parsed = prom_lib.parse(text)
+        assert parsed["al_run_round"][()] == 3
+        assert parsed["al_serve_requests_total"][
+            (("endpoint", "/v1/score"),)] == 17
+        assert parsed["al_serve_request_latency_ms"][
+            (("quantile", "0.99"),)] == 12.75
+        assert parsed["weird_name_with_dots"][()] == 1.5
+        assert parsed["bool_gauge"][()] == 1
+        assert "dropped_none" not in parsed
+        # One TYPE header per metric name, before its samples.
+        assert text.count("# TYPE al_serve_requests_total gauge") == 1
+
+    def test_label_escaping(self):
+        text = prom_lib.render([("m", {"k": 'a"b\\c\nd'}, 1)])
+        parsed = prom_lib.parse(text)
+        assert parsed["m"][(("k", 'a"b\\c\nd'),)] == 1
+
+    def test_serve_metrics_endpoint_prometheus_view(self):
+        """GET /metrics?format=prometheus through the real router over a
+        stub executor/batcher: valid exposition, text content type, and
+        the serving contract (request_path_compiles) scrapable."""
+        import asyncio
+
+        from active_learning_tpu.config import ServeConfig
+        from active_learning_tpu.serve.server import ScoringServer
+
+        class StubExecutor:
+            _lock = threading.Lock()
+            stats = {"batches": 3, "rows": 170, "reloads": 1,
+                     "warm_buckets": [8, 16]}
+            served_round = 2
+
+            def compile_counts(self):
+                return {"prob_stats": 2, "embed": 2}
+
+            def request_path_compiles(self):
+                return 0
+
+        class StubBatcher:
+            pending_rows = 5
+            buckets = (8, 16)
+
+        server = ScoringServer(StubExecutor(), ServeConfig(queue_depth=64))
+        server.batcher = StubBatcher()
+        server.metrics.record_request("/v1/score")
+        server.metrics.record_response(200, 0.012, rows=8)
+        server.metrics.record_batch(8, 5)
+
+        status, payload, headers = asyncio.run(
+            server._route("GET", "/metrics?format=prometheus", b""))
+        assert status == 200 and isinstance(payload, str)
+        assert headers["Content-Type"].startswith("text/plain")
+        parsed = prom_lib.parse(payload)
+        assert parsed["al_serve_request_path_compiles"][()] == 0
+        assert parsed["al_serve_served_round"][()] == 2
+        assert parsed["al_serve_requests_total"][
+            (("endpoint", "/v1/score"),)] == 1
+        assert parsed["al_serve_batch_occupancy_total"][
+            (("bucket", "8"), ("rows", "5"))] == 1
+        assert parsed["al_serve_queue_pending_rows"][()] == 5
+        # The JSON view is unchanged, and a junk format is a 400.
+        status, payload, _ = asyncio.run(
+            server._route("GET", "/metrics", b""))
+        assert status == 200 and isinstance(payload, dict)
+        status, _, _ = asyncio.run(
+            server._route("GET", "/metrics?format=xml", b""))
+        assert status == 400
+
+    def test_scrape_file_write_is_atomic(self, tmp_path):
+        path = str(tmp_path / "run.prom")
+        assert prom_lib.write_textfile(path, "# TYPE a gauge\na 1\n")
+        assert prom_lib.parse(open(path).read())["a"][()] == 1
+        assert [f for f in os.listdir(tmp_path)
+                if f.startswith("run.prom.tmp")] == []
+
+
+class TestTelemetryOffPath:
+    def test_default_runtime_is_inert(self, tmp_path):
+        rt = rt_lib.get_run()
+        assert rt.train_metrics is False
+        rt.tick(round=1)                      # no heartbeat, no file
+        rt.register_jit("x", lambda: None)    # no registry growth
+        assert rt.jit_cache_sizes() == {}
+        assert rt.export_trace() is None
+        assert os.listdir(tmp_path) == []
+        assert spans_lib.get_tracer().enabled is False
+
+    def test_fit_emits_no_step_metrics_when_off(self, tmp_path):
+        """With no run installed, the trainer's metric_cb sees exactly
+        the pre-telemetry names — no step_time/imgs_per_sec/EMA series,
+        no per-step timing work."""
+        import dataclasses
+
+        import jax
+
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.train import checkpoint as ckpt_lib
+        from active_learning_tpu.train.trainer import Trainer
+        from helpers import TinyClassifier, tiny_train_config
+
+        train_set, _, al_set = get_data_synthetic(
+            n_train=32, n_test=8, num_classes=4, image_size=8, seed=3)
+        cfg = dataclasses.replace(tiny_train_config(batch_size=16),
+                                  device_resident=False)
+        trainer = Trainer(TinyClassifier(), cfg, mesh_lib.make_mesh(),
+                          num_classes=4, train_bn=True)
+        state = trainer.init_state(jax.random.PRNGKey(0),
+                                   train_set.gather(np.arange(2)))
+        names = []
+        trainer.fit(state, train_set, np.arange(24), al_set,
+                    np.arange(24, 32), n_epoch=2, es_patience=2,
+                    rng=np.random.default_rng(0), round_idx=0,
+                    weight_paths=ckpt_lib.weight_paths(
+                        str(tmp_path), "t", "off", 0),
+                    metric_cb=lambda n, v, s: names.append(n))
+        assert not any(n.startswith(("step_time", "imgs_per_sec",
+                                     "train_loss_ema", "grad_norm_ema"))
+                       for n in names)
+        assert any("validation_accuracy" in n for n in names)
+
+    def test_per_step_record_cost_supports_overhead_budget(self, tmp_path):
+        """The default-on per-step work is a perf_counter delta + list
+        append + rate-limited heartbeat tick.  Bound it hard: 10k
+        simulated steps well under 0.5 s total (<50 µs/step — noise
+        against ms-scale real steps: the DESIGN §7 overhead budget)."""
+        hb = hb_lib.HeartbeatWriter(str(tmp_path / "hb.json"),
+                                    every_s=3600.0)
+        t0 = time.perf_counter()
+        times = []
+        prev = time.perf_counter()
+        for i in range(10_000):
+            now = time.perf_counter()
+            times.append(now - prev)
+            prev = now
+            hb.tick(epoch=1, step=i)
+        assert time.perf_counter() - t0 < 0.5
+        assert len(times) == 10_000
+
+
+class TestRunTelemetryLifecycle:
+    def test_start_finish_install_uninstall(self, tmp_path):
+        from active_learning_tpu.config import TelemetryConfig
+
+        cfg = TelemetryConfig(enabled=True, export_trace=True,
+                              watchdog=True, heartbeat_every_s=0.0,
+                              stall_deadline_s=60.0,
+                              prometheus_file=str(tmp_path / "g.prom"))
+        rt = rt_lib.start_run(cfg, log_dir=str(tmp_path))
+        try:
+            assert rt_lib.get_run() is rt
+            assert spans_lib.get_tracer() is rt.tracer
+            assert rt.train_metrics is True
+            with spans_lib.get_tracer().span("experiment"):
+                rt.tick(round=0, phase="query")
+            rt.set_gauges(round=0, imgs_per_sec=123.4)
+        finally:
+            rt.finish("finished")
+            rt_lib.uninstall(rt)
+        hb = hb_lib.read_heartbeat(str(tmp_path / "heartbeat.json"))
+        assert hb["status"] == "finished" and hb["round"] == 0
+        trace = json.load(open(tmp_path / "trace.json"))
+        assert trace["otherData"]["status"] == "finished"
+        parsed = prom_lib.parse(open(tmp_path / "g.prom").read())
+        assert parsed["al_run_imgs_per_sec"][()] == pytest.approx(123.4)
+        # Uninstalled: back to the inert default.
+        assert rt_lib.get_run().train_metrics is False
+        assert spans_lib.get_tracer().enabled is False
+
+    def test_disabled_config_installs_inert_runtime(self, tmp_path):
+        from active_learning_tpu.config import TelemetryConfig
+
+        rt = rt_lib.start_run(TelemetryConfig(enabled=False),
+                              log_dir=str(tmp_path))
+        try:
+            assert rt.train_metrics is False
+            assert rt.heartbeat is None
+            rt.tick(round=1)
+            assert os.listdir(tmp_path) == []
+        finally:
+            rt.finish()
+            rt_lib.uninstall(rt)
+
+    def test_multiprocess_heartbeat_filename(self):
+        assert hb_lib.heartbeat_filename(0, 1) == "heartbeat.json"
+        assert hb_lib.heartbeat_filename(0, 4) == "heartbeat_p0.json"
+        assert hb_lib.heartbeat_filename(3, 4) == "heartbeat_p3.json"
+
+
+class TestEndToEndSmoke:
+    """The acceptance-criteria smoke: a CPU-mesh synthetic run with
+    telemetry on produces (a) nested Chrome-trace spans, (b) a fresh
+    heartbeat the status verb flags stale once its mtime ages past the
+    deadline, (c) per-epoch step_time_ms_p50/p99 + imgs_per_sec in
+    metrics.jsonl."""
+
+    @pytest.fixture(scope="class")
+    def smoke_run(self, tmp_path_factory):
+        from active_learning_tpu.config import (ExperimentConfig,
+                                                TelemetryConfig)
+        from active_learning_tpu.experiment.driver import run_experiment
+
+        tmp = str(tmp_path_factory.mktemp("tele_smoke"))
+        cfg = ExperimentConfig(
+            dataset="synthetic", arg_pool="synthetic",
+            strategy="MarginSampler", rounds=2, round_budget=16,
+            n_epoch=2, early_stop_patience=2, log_dir=tmp, ckpt_path=tmp,
+            exp_hash="telesmoke",
+            telemetry=TelemetryConfig(enabled=True, export_trace=True,
+                                      watchdog=True,
+                                      heartbeat_every_s=0.0,
+                                      stall_deadline_s=120.0))
+        run_experiment(cfg)
+        return tmp
+
+    def test_trace_json_is_valid_and_nested(self, smoke_run):
+        trace = json.load(open(os.path.join(smoke_run, "trace.json")))
+        events = trace["traceEvents"]
+        names = {e["name"] for e in events}
+        # The span hierarchy of DESIGN §7: experiment → round → phase →
+        # epoch → collect_pool chunk.
+        for expected in ("experiment", "round", "train_time", "test_time",
+                         "query_time", "epoch", "collect_pool",
+                         "collect_pool_chunk"):
+            assert expected in names, f"missing span {expected!r}"
+        spans = {e["name"]: e for e in events}
+        exp = spans["experiment"]
+        for e in events:
+            assert e["ts"] >= exp["ts"] - 1e-6
+            assert (e["ts"] + e.get("dur", 0.0)
+                    <= exp["ts"] + exp["dur"] + 1e-6)
+        rounds = [e for e in events if e["name"] == "round"]
+        assert len(rounds) == 2
+        # Every epoch span nests inside some train phase span.
+        trains = [e for e in events if e["name"] == "train_time"]
+        for ep in (e for e in events if e["name"] == "epoch"):
+            assert any(t["ts"] <= ep["ts"]
+                       and ep["ts"] + ep["dur"] <= t["ts"] + t["dur"] + 1e-6
+                       for t in trains)
+
+    def test_heartbeat_fresh_then_stale_via_status(self, smoke_run):
+        hb_path = os.path.join(smoke_run, "heartbeat.json")
+        hb = hb_lib.read_heartbeat(hb_path)
+        assert hb["status"] == "finished"
+        assert hb["round"] == 1
+        summary = status_lib.summarize(smoke_run)
+        assert summary["state"] == "ok"  # finished runs are never stale
+        # A RUNNING heartbeat whose mtime ages past the deadline reads
+        # STALE through the same summarize path the CLI verb uses.
+        hb_run = hb_lib.HeartbeatWriter(hb_path, every_s=0.0,
+                                        stall_deadline_s=120.0)
+        hb_run.tick(round=1, phase="train", status="running")
+        old = time.time() - 1000.0
+        os.utime(hb_path, (old, old))
+        summary = status_lib.summarize(smoke_run)
+        assert summary["state"] == "stale"
+        assert summary["heartbeats"][0]["stale"] is True
+        assert summary["metrics"].get("rd_test_accuracy") is not None
+        text = status_lib.render_text(summary)
+        assert "STALE" in text and "rd_test_accuracy" in text
+
+    def test_per_epoch_telemetry_lands_in_metrics_jsonl(self, smoke_run):
+        by_name = {}
+        for line in open(os.path.join(smoke_run, "metrics.jsonl")):
+            ev = json.loads(line)
+            if ev.get("kind") == "metric":
+                for k, v in ev["metrics"].items():
+                    by_name.setdefault(k, []).append((ev.get("step"), v))
+        for name in ("step_time_ms_p50", "step_time_ms_p99",
+                     "imgs_per_sec", "train_loss_ema", "grad_norm_ema",
+                     "pool_rows_per_sec", "jit_cache_miss_delta"):
+            assert name in by_name, f"missing {name}"
+        # 2 rounds x 2 epochs of step-time series, positive values,
+        # p99 >= p50, monotonic round-folded step axis.
+        p50 = by_name["step_time_ms_p50"]
+        p99 = by_name["step_time_ms_p99"]
+        assert len(p50) == 4 and len(p99) == 4
+        steps = [s for s, _ in p50]
+        assert steps == sorted(steps) and len(set(steps)) == 4
+        assert all(v > 0 for _, v in p50)
+        assert all(q >= p for (_, p), (_, q) in zip(p50, p99))
+        assert all(v > 0 for _, v in by_name["imgs_per_sec"])
+        assert all(v > 0 for _, v in by_name["grad_norm_ema"])
+        # Warm rounds must not compile: the round-1 miss delta is 0.
+        deltas = dict(by_name["jit_cache_miss_delta"])
+        assert deltas[1] == 0, f"round-1 jit cache misses: {deltas[1]}"
+
+    def test_status_cli_subprocess_no_jax(self, smoke_run):
+        """The status verb answers from a plain subprocess — and never
+        imports jax (it must work against a wedged run)."""
+        code = (
+            "import sys\n"
+            "from active_learning_tpu.telemetry.status import main\n"
+            f"rc = main(['--log_dir', {smoke_run!r}, '--json'])\n"
+            "assert 'jax' not in sys.modules, 'status imported jax'\n"
+            "sys.exit(rc)\n")
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=60,
+            cwd=os.path.abspath(REPO))
+        assert proc.returncode in (0, 3), proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["heartbeats"]
+
+
+class TestTraceLint:
+    def test_trace_lint_passes_from_tier1(self):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_lint.py")],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+
+    # The negative case, without polluting the real tree:
+    def test_lint_logic_flags_competing_definition(self, tmp_path,
+                                                   monkeypatch):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_lint", os.path.join(REPO, "scripts", "trace_lint.py"))
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+        bad = tmp_path / "rogue.py"
+        bad.write_text("def phase_timer(name):\n    return name\n")
+        monkeypatch.setattr(
+            lint, "_py_files",
+            lambda: [str(bad)])
+        problems = lint.check()
+        assert any("defines its own phase_timer" in p for p in problems)
+
+
+class TestSatelliteFixes:
+    def test_setup_logging_appends_on_resume(self, tmp_path):
+        """The resume log-loss fix: a second setup_logging over the same
+        file (resume) must APPEND, not truncate prior rounds' lines."""
+        from active_learning_tpu.utils.logging import setup_logging
+
+        logger = setup_logging(str(tmp_path), "run.log")
+        logger.info("round 0 done")
+        for h in list(logger.handlers):
+            h.close()
+        logger = setup_logging(str(tmp_path), "run.log")  # resume
+        logger.info("resumed at round 1")
+        for h in list(logger.handlers):
+            h.close()
+            logger.removeHandler(h)
+        content = open(tmp_path / "run.log").read()
+        assert "round 0 done" in content        # survived the resume
+        assert "resumed at round 1" in content
+        # A FRESH file still starts clean (mode "w" path).
+        logger = setup_logging(str(tmp_path), "fresh.log")
+        logger.info("fresh line")
+        for h in list(logger.handlers):
+            h.close()
+            logger.removeHandler(h)
+        assert open(tmp_path / "fresh.log").read().count("\n") == 1
+
+    def test_tensorboard_auto_step_is_per_name(self):
+        """TensorBoardSink._auto_step satellite: call sites omitting
+        ``step`` get a PER-NAME 1,2,3,... axis, not a shared counter
+        scrambled across unrelated series.  (Fake writer: importing the
+        real SummaryWriter drags in TensorFlow, slow-tier only.)"""
+        from active_learning_tpu.utils.metrics import TensorBoardSink
+
+        calls = []
+
+        class FakeWriter:
+            def add_scalar(self, name, value, global_step=None):
+                calls.append((name, value, global_step))
+
+            def flush(self):
+                pass
+
+        sink = TensorBoardSink.__new__(TensorBoardSink)
+        sink._writer = FakeWriter()
+        sink.log_metrics({"a": 1.0})
+        sink.log_metrics({"b": 10.0})
+        sink.log_metrics({"a": 2.0, "b": 20.0})
+        sink.log_metrics({"a": 3.0}, step=99)  # explicit step untouched
+        sink.log_metrics({"a": 4.0})
+        assert calls == [
+            ("a", 1.0, 1), ("b", 10.0, 1),
+            ("a", 2.0, 2), ("b", 20.0, 2),
+            ("a", 3.0, 99),
+            ("a", 4.0, 3),
+        ]
+
+    def test_compilation_cache_default_off_on_cpu(self, tmp_path,
+                                                  monkeypatch):
+        """The donation-corruption gate: on a CPU-configured platform
+        the DEFAULT persistent cache stays off; an explicit dir still
+        wins (deliberate operator choice, and what the existing
+        test_compile_reuse config test exercises)."""
+        import jax
+
+        from active_learning_tpu.experiment import driver
+
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        old = jax.config.jax_compilation_cache_dir
+        try:
+            assert driver.enable_compilation_cache(None) is None
+            explicit = str(tmp_path / "explicit_cache")
+            assert driver.enable_compilation_cache(explicit) == explicit
+            # $JAX_COMPILATION_CACHE_DIR is the same explicit opt-in as
+            # the flag — the CPU gate suppresses only the implicit
+            # default.
+            env_dir = str(tmp_path / "env_cache")
+            monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", env_dir)
+            assert driver.enable_compilation_cache(None) == env_dir
+        finally:
+            # The enable leaks process-wide jax config; the REST of the
+            # session must keep running cache-less (the very corruption
+            # this gate exists for).
+            jax.config.update("jax_compilation_cache_dir", old)

@@ -59,7 +59,7 @@ def one_step(trainer, mesh, batch, seed=0):
     state = trainer.init_state(jax.random.PRNGKey(seed),
                                batch["image"][:2])
     cw = jnp.ones(trainer.num_classes, jnp.float32)
-    new_state, loss = trainer._train_step(
+    new_state, loss, _gnorm = trainer._train_step(
         state, mesh_lib.shard_batch(batch, mesh), jax.random.PRNGKey(7),
         jnp.float32(0.1), cw, view=VIEW)
     return jax.tree.map(np.asarray, new_state.variables), float(loss)
