@@ -134,6 +134,26 @@ class TrainConfig:
     # images fit in HBM and the labeled set is large enough to amortize
     # the extra compile), True = force on, False = host-batched path.
     device_resident: Optional[bool] = None
+    # Train-feed selection (the feed hierarchy, DESIGN.md §2a:
+    # resident-gather > prefetched-host > serial-host).
+    #   "auto"     — when the pool is pinned in HBM (or fits the resident
+    #                budget) and the device-resident scan is worthwhile
+    #                (see device_resident), train batches are ON-DEVICE
+    #                gathers of labeled indices from that SAME pinned
+    #                array — zero host image copies; otherwise the legacy
+    #                labeled-subset upload, then the host feed.
+    #   "resident" — force the resident-gather feed (falls back down the
+    #                hierarchy with a logged warning when impossible:
+    #                disk-backed pool, VAAL batch_hook, budget 0).
+    #   "host"     — force the host feed (multi-worker + device-prefetch
+    #                when feed_workers/prefetch allow, else serial).
+    # Every feed produces a bit-identical batch stream at the same seeds
+    # (tests/test_trainer_parallel.py) — this knob is throughput-only.
+    train_feed: str = "auto"
+    # Gather/decode worker threads for the host train feed; None defers
+    # to loader_tr.num_workers (the reference's DataLoader num_workers).
+    # The double-buffered device prefetch depth rides loader_tr.prefetch.
+    feed_workers: Optional[int] = None
     # Epoch cadence for the current-weights checkpoint AND the mid-round
     # fit-state save (the reference writes rd_{n}.pth every epoch,
     # strategy.py:440; a full-variable host transfer per epoch would
@@ -174,9 +194,13 @@ class TrainConfig:
     # bytes_in_use − a training-activation reserve), so any pool that
     # fits the chip pins by default; backends without memory statistics
     # fall back to a conservative 2 GB.  An explicit integer pins the
-    # budget (0 disables both resident paths).  The budget applies per
-    # underlying image array that fits under it (the AL pool and the
-    # test set are separate arrays, so each may pin up to this size).
+    # budget (0 disables both resident paths).  The budget is accounted
+    # across the WHOLE resident cache (parallel/resident.pinned_bytes):
+    # the AL pool, the test set, and the train feed share one pot, and
+    # the al/train views' shared storage counts ONCE — one pinned pool
+    # serves scoring, evaluation, AND training for one array's worth of
+    # HBM.  Shrinking an explicit budget mid-run demotes pinned pools
+    # LRU-first (parallel/resident.enforce_budget).
     resident_scoring_bytes: Optional[int] = None
 
     @property
@@ -345,6 +369,17 @@ class ExperimentConfig:
     # is on-device gathers (no per-batch host->device image traffic).
     # Pass an explicit integer to pin the budget, 0 to disable residency.
     resident_scoring_bytes: Optional[int] = None
+
+    # Train-feed override ("auto"/"resident"/"host"): None defers to the
+    # arg pool's TrainConfig.train_feed.  See TrainConfig.train_feed for
+    # the feed hierarchy (resident-gather > prefetched-host >
+    # serial-host); every feed is bit-identical at the same seeds.
+    train_feed: Optional[str] = None
+
+    # Host train-feed gather/decode worker threads: None defers to the
+    # arg pool (TrainConfig.feed_workers -> loader_tr.num_workers, the
+    # reference's DataLoader num_workers row).
+    feed_workers: Optional[int] = None
 
     # Coreset / BADGE partitioning (parser.py:74-79)
     subset_labeled: Optional[int] = None

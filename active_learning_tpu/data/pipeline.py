@@ -148,5 +148,42 @@ def iterate_batches(
         executor.shutdown(wait=False, cancel_futures=True)
 
 
+def train_feed_batches(
+    dataset: Dataset,
+    idxs: np.ndarray,
+    batch_size: int,
+    rng: Optional[np.random.Generator] = None,
+    shuffle: bool = True,
+    num_workers: int = 0,
+    prefetch: int = 2,
+    local: Optional[slice] = None,
+    s2d: bool = False,
+    put=None,
+    depth: int = 2,
+):
+    """The prefetched-host train feed: ``num_workers`` gather/decode
+    threads (driving the native/decode.cpp thread-pool decoder for disk
+    trees and the memmap cache for decoded pools) assemble fixed-shape
+    batches IN ORDER, and — when ``put`` is given — the double-buffered
+    ``device_prefetch`` stage dispatches batch n+1's host->device
+    transfer while batch n computes, ``depth`` batches deep.
+
+    This is the host leg of the trainer's feed hierarchy
+    (resident-gather > prefetched-host > serial-host): batch membership
+    and order are EXACTLY ``iterate_batches(shuffle=True)``'s, so the
+    stream is bit-identical to the serial loop at the same rng state —
+    workers and prefetch change wall-clock only, never a pixel.  It is
+    also the reference's DataLoader ``num_workers``/``prefetch_factor``
+    counterpart (arg_pools/default.py:29-38) for the train loader.
+    """
+    batches = iterate_batches(dataset, idxs, batch_size, shuffle=shuffle,
+                              rng=rng, num_threads=num_workers,
+                              prefetch=prefetch, local=local, s2d=s2d)
+    if put is None:
+        return batches
+    from .cache import device_prefetch
+    return device_prefetch(batches, put, depth=max(1, depth))
+
+
 def num_batches(n: int, batch_size: int, drop_last: bool = False) -> int:
     return n // batch_size if drop_last else -(-n // batch_size)

@@ -119,6 +119,17 @@ def get_parser() -> argparse.ArgumentParser:
                         "in HBM and later query/eval passes are on-device "
                         "gathers.  Pass an integer to pin the budget, 0 "
                         "to disable residency.")
+    p.add_argument("--train_feed", type=str, default=None,
+                   choices=["auto", "resident", "host"],
+                   help="train-batch feed: auto picks the top of the "
+                        "hierarchy (resident-gather from the pinned pool "
+                        "> prefetched-host > serial-host); resident/host "
+                        "force a leg.  All feeds are bit-identical at "
+                        "the same seeds — throughput only")
+    p.add_argument("--feed_workers", type=int, default=None,
+                   help="gather/decode worker threads for the host train "
+                        "feed (the reference DataLoader's num_workers); "
+                        "default defers to the arg pool's train loader")
     # Coreset / BADGE scale controls (parser.py:74-79)
     p.add_argument("--subset_labeled", type=int, default=None)
     p.add_argument("--subset_unlabeled", type=int, default=None)
@@ -192,6 +203,8 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         bn_stats_dtype=args.bn_stats_dtype,
         stem=args.stem,
         resident_scoring_bytes=args.resident_scoring_bytes,
+        train_feed=args.train_feed,
+        feed_workers=args.feed_workers,
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,
         partitions=args.partitions,

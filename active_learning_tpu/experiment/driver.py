@@ -186,6 +186,15 @@ def build_experiment(
         # arg-pool default is None = auto-size from live HBM headroom.)
         train_cfg = dataclasses.replace(
             train_cfg, resident_scoring_bytes=cfg.resident_scoring_bytes)
+    if cfg.train_feed is not None:
+        # --train_feed beats the arg pool for the same reason: which leg
+        # of the feed hierarchy wins is a deployment/HBM question, and
+        # every leg is bit-identical at the same seeds anyway.
+        train_cfg = dataclasses.replace(train_cfg,
+                                        train_feed=cfg.train_feed)
+    if cfg.feed_workers is not None:
+        train_cfg = dataclasses.replace(train_cfg,
+                                        feed_workers=cfg.feed_workers)
     if mesh is None:
         mesh = mesh_lib.make_mesh(cfg.num_devices)
     trainer = Trainer(model, train_cfg, mesh, num_classes)
@@ -236,11 +245,19 @@ def _emit_round_telemetry(telemetry, sink: MetricsSink, rd: int,
     hbm = tele_runtime.hbm_high_water_gb()
     if hbm is not None:
         sink.log_metric("hbm_peak_gb", hbm, step=rd)
+    # Feed-boundedness gauges from the round's fit (trainer.last_feed):
+    # a host-bound warm round reads off the Prometheus scrape / `status`
+    # without a profiler.  feed_source is non-numeric, so it rides the
+    # heartbeat detail instead (the trainer ticks `feed=` every epoch;
+    # `status` renders it).
+    feed = strategy.trainer.last_feed
     telemetry.set_gauges(
         round=rd, cumulative_budget=strategy.pool.cumulative_cost,
         labeled=strategy.pool.num_labeled,
         jit_cache_total=telemetry.jit_cache_total(),
-        hbm_peak_gb=hbm)
+        hbm_peak_gb=hbm,
+        feed_stall_frac=feed.get("feed_stall_frac"),
+        host_wait_ms_p50=feed.get("host_wait_ms_p50"))
     telemetry.write_prometheus()
     telemetry.export_trace()
     telemetry.tick(force=True, phase="round_end", round=rd)

@@ -2,16 +2,21 @@
 experiment and gathered ON DEVICE per batch.
 
 One cache serves every consumer — acquisition scoring
-(strategies/scoring.py) and evaluation (train/trainer.py) — so a pool
-whose views share storage (ArrayDataset.with_view) is uploaded exactly
-once, and the ``resident_scoring_bytes`` budget means what it says per
-underlying array.  Entries retain their dataset object: keys include
+(strategies/scoring.py), evaluation, AND the trainer's resident-gather
+train feed (train/trainer.py) — so a pool whose views share storage
+(ArrayDataset.with_view) is uploaded exactly once and that single pinned
+array feeds scoring, validation, and training.  The byte budget is
+accounted across the WHOLE cache: ``eligible`` admits a new array only
+when it fits alongside everything already pinned, so "one pinned pool
+serves both scoring and training" is also one set of bytes in the
+budget, never two.  Entries retain their dataset object: keys include
 id()s, and without the reference a recycled id could silently alias
 another pool's images.
 
 Layout of a cache dict:
   cache["images"][(id(images), n)] = (dataset, images_dev, labels_dev)
   cache["steps"][(id(step_fn), with_labels)] = jitted runner
+  cache["lru"] = [key, ...]  # least-recently-used first (eviction order)
 
 Virtual-CPU-mesh caveat: the N replicas' on-device gathers execute
 serially on one core there, so resident paths can measure slower on the
@@ -38,9 +43,20 @@ AUTO_RESERVE_BYTES = 4 << 30
 
 
 def auto_budget(reserve_bytes: int = AUTO_RESERVE_BYTES,
-                stats: Optional[Dict[str, int]] = None) -> int:
+                stats: Optional[Dict[str, int]] = None,
+                pinned: int = 0) -> int:
     """Size the device-resident pool budget from LIVE HBM headroom:
     (bytes_limit − bytes_in_use) − reserve, floored at 0.
+
+    ``pinned``: bytes ALREADY pinned in the caller's resident cache.
+    Live headroom has those bytes netted out (they sit in bytes_in_use),
+    but the budget is consumed as a TOTAL cap by the shared accounting
+    in ``eligible`` — so they are added back, making the auto budget a
+    total cap too.  Without this, a round-start refresh would charge
+    every pinned pool twice (once inside bytes_in_use, once in
+    pinned_bytes) and reject new pools that actually fit.  The static
+    fallback budget is already a total cap, so ``pinned`` is NOT added
+    there.
 
     ``stats`` injects a memory_stats dict for tests; by default the first
     local device is asked.  Backends that expose no memory statistics
@@ -58,7 +74,8 @@ def auto_budget(reserve_bytes: int = AUTO_RESERVE_BYTES,
     if not limit:
         budget = RESIDENT_SCORING_BYTES_DEFAULT
     else:
-        budget = max(0, int(limit) - int(in_use) - int(reserve_bytes))
+        budget = max(0, int(limit) - int(in_use) - int(reserve_bytes)) \
+            + int(pinned)
     if jax.process_count() > 1:
         # Every process must resolve the SAME budget: the budget decides
         # resident-vs-streamed scoring, which are different collective
@@ -74,24 +91,52 @@ def auto_budget(reserve_bytes: int = AUTO_RESERVE_BYTES,
 
 
 def resolve_budget(spec: Optional[int],
-                   stats: Optional[Dict[str, int]] = None) -> int:
+                   stats: Optional[Dict[str, int]] = None,
+                   cache: Optional[Dict] = None) -> int:
     """TrainConfig.resident_scoring_bytes -> concrete byte budget:
     None = auto-size from live HBM headroom (pool residency is the
     DEFAULT behavior, not an override); an explicit integer — including
-    0 to disable — is taken as-is."""
+    0 to disable — is taken as-is.  ``cache``: the caller's resident
+    cache, so a live-headroom auto budget stays a TOTAL cap alongside
+    the shared accounting (see auto_budget's ``pinned``)."""
     if spec is None:
-        budget = auto_budget(stats=stats)
+        budget = auto_budget(stats=stats, pinned=pinned_bytes(cache))
         get_logger().debug(
             f"resident pool budget auto-sized to {budget / 1e9:.1f} GB")
         return budget
     return int(spec)
 
 
-def eligible(dataset: Any, max_bytes: int) -> bool:
-    """In-memory (ArrayDataset-style) and within the byte budget."""
+def pinned_bytes(cache: Optional[Dict]) -> int:
+    """Total bytes of every image array currently pinned in ``cache``
+    (per-replica logical bytes — replication is per-chip, and the budget
+    is a per-chip HBM figure)."""
+    if not cache:
+        return 0
+    return sum(int(entry[1].nbytes)
+               for entry in cache.get("images", {}).values())
+
+
+def eligible(dataset: Any, max_bytes: int,
+             cache: Optional[Dict] = None) -> bool:
+    """In-memory (ArrayDataset-style) and within the byte budget.
+
+    With a ``cache``, the budget is shared across every pinned array:
+    a new pool is admitted only if it fits ALONGSIDE what is already
+    resident, and an already-pinned pool is ALWAYS eligible — checked
+    before the budget guard, so a pool pinned before the budget shrank
+    (even to 0) keeps its fast path: its bytes sit in HBM either way,
+    and streaming would pay twice (the rule previously restated as
+    ``or cached(...)`` at every call site — this is the one spelling).
+    Without a cache (direct callers), the old single-array check
+    applies."""
+    if cache is not None and cached(cache, dataset):
+        return True
     images = getattr(dataset, "images", None)
-    return (max_bytes > 0 and isinstance(images, np.ndarray)
-            and images[: len(dataset)].nbytes <= max_bytes)
+    if not (max_bytes > 0 and isinstance(images, np.ndarray)):
+        return False
+    return (pinned_bytes(cache) + images[: len(dataset)].nbytes
+            <= max_bytes)
 
 
 def cached(cache: Optional[Dict], dataset: Any) -> bool:
@@ -111,7 +156,8 @@ def cached(cache: Optional[Dict], dataset: Any) -> bool:
 def pool_arrays(cache: Dict, dataset: Any, mesh) -> Tuple[Any, Any]:
     """(images_dev, labels_dev) for the dataset, uploaded once per
     (underlying array, length) — views sharing storage share the upload.
-    replicate() device_puts EXPLICITLY (transfer-guard friendly)."""
+    replicate() device_puts EXPLICITLY (transfer-guard friendly).  Every
+    access refreshes the entry's position in the LRU eviction order."""
     images = cache.setdefault("images", {})
     n = len(dataset)
     key = (id(dataset.images), n)
@@ -122,7 +168,40 @@ def pool_arrays(cache: Dict, dataset: Any, mesh) -> Tuple[Any, Any]:
                 np.ascontiguousarray(dataset.images[:n]), mesh),
             mesh_lib.replicate(
                 dataset.targets[:n].astype(np.int32), mesh))
+    lru = cache.setdefault("lru", [])
+    if key in lru:
+        lru.remove(key)
+    lru.append(key)
     return images[key][1], images[key][2]
+
+
+def enforce_budget(cache: Optional[Dict], max_bytes: int) -> list:
+    """Demote pinned pools, least-recently-used first, until the cache
+    fits ``max_bytes`` — the clean-shrink path for an EXPLICIT budget
+    that got smaller mid-run (the AUTO budget never demotes: an
+    already-pinned pool's bytes are part of the headroom it measures,
+    see ``cached``).  Dropping the entry releases the device buffers;
+    consumers notice via ``cached()`` turning False and fall back to
+    their host paths at the next call — no shape change, no recompile,
+    because the host paths' batch shapes were never a function of
+    residency.  Returns the demoted keys."""
+    if not cache:
+        return []
+    images = cache.get("images", {})
+    lru = cache.get("lru", [])
+    demoted = []
+    while images and pinned_bytes(cache) > max(0, int(max_bytes)):
+        key = next((k for k in lru if k in images), next(iter(images)))
+        images.pop(key)
+        if key in lru:
+            lru.remove(key)
+        demoted.append(key)
+    if demoted:
+        get_logger().info(
+            f"resident pool budget shrank to {max_bytes / 1e9:.2f} GB: "
+            f"demoted {len(demoted)} pinned pool(s); affected consumers "
+            "fall back to host-streamed paths")
+    return demoted
 
 
 def get_runner(cache: Dict, step_fn: Callable, mesh,

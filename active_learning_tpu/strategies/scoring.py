@@ -358,8 +358,8 @@ def collect_pool(
     # pool that is ALREADY uploaded keeps its fast path even if a budget
     # refresh shrank the budget below its size (resident_lib.cached).
     if (resident_cache is not None
-            and (resident_lib.eligible(dataset, resident_max_bytes)
-                 or resident_lib.cached(resident_cache, dataset))):
+            and resident_lib.eligible(dataset, resident_max_bytes,
+                                      cache=resident_cache)):
         images_dev, _ = resident_lib.pool_arrays(resident_cache, dataset,
                                                  mesh)
         run = resident_lib.get_runner(resident_cache, step_fn, mesh)

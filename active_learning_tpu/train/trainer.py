@@ -39,7 +39,8 @@ from ..telemetry import runtime as tele_runtime
 from ..telemetry import spans as tele_spans
 from ..data.core import Dataset
 from ..data.pipeline import (batch_index_lists, iterate_batches,
-                             num_batches, padded_batch_layout)
+                             num_batches, padded_batch_layout,
+                             train_feed_batches)
 from ..parallel import mesh as mesh_lib
 from ..utils.logging import get_logger
 from . import checkpoint as ckpt_lib
@@ -109,6 +110,9 @@ class Trainer:
         self._train_step = self._build_train_step()
         self._chained_train_step = self._build_chained_train_step()
         self._epoch_scan: Optional[Callable] = None  # built on first use
+        # The resident feed's per-batch execution form (CPU meshes; see
+        # _build_resident_batch_step) — also lazy.
+        self._resident_batch_step: Optional[Callable] = None
         # The generalized jit-compile counter (telemetry/runtime.py): a
         # no-op unless a run installed telemetry, so unit-test Trainers
         # never accumulate in a process-global registry.
@@ -129,17 +133,46 @@ class Trainer:
         from ..parallel import resident as resident_lib
         self.resident_budget = resident_lib.resolve_budget(
             train_cfg.resident_scoring_bytes)
+        # The feed the LAST fit actually used + its host-stall figures —
+        # round-boundary telemetry (driver gauges) and bench attribution
+        # read it; {"source": None} until a fit has run.
+        self.last_feed: Dict[str, Any] = {"source": None}
 
     def refresh_resident_budget(self) -> int:
         """Re-size the AUTO resident budget from current HBM headroom
-        (called by the driver at round start; explicit integer configs are
-        left alone).  Pools already uploaded stay resident regardless —
-        their bytes are already counted in bytes_in_use, so a post-upload
-        refresh must not evict them (parallel/resident.cached)."""
+        (called by the driver at round start).  AUTO-budget pools already
+        uploaded stay resident regardless — their bytes are already
+        counted in bytes_in_use, so a post-upload refresh must not evict
+        them (parallel/resident.cached).  An EXPLICIT budget is enforced
+        instead: pools over it demote LRU-first (the clean-shrink path —
+        a resumed run with a smaller --resident_scoring_bytes, or an
+        in-process set_resident_budget)."""
         from ..parallel import resident as resident_lib
         if self.cfg.resident_scoring_bytes is None:
-            self.resident_budget = resident_lib.resolve_budget(None)
+            # Pass the cache: pinned pools sit inside bytes_in_use, so
+            # the headroom-derived budget must add them back to stay a
+            # TOTAL cap under the shared eligible() accounting.
+            self.resident_budget = resident_lib.resolve_budget(
+                None, cache=self.resident_pool)
+        else:
+            resident_lib.enforce_budget(self.resident_pool,
+                                        self.resident_budget)
         return self.resident_budget
+
+    def set_resident_budget(self, budget: int) -> list:
+        """Shrink (or grow) the resident budget mid-run: the new budget
+        is enforced immediately — pinned pools over it demote LRU-first
+        and every consumer (scoring, evaluation, the resident-gather
+        train feed, including its auto-mode resident_copy fallback,
+        whose private upload is charged against the same budget) falls
+        back to its host path at the next call, without a batch-shape
+        change or a recompile.  Only an EXPLICIT device_resident=True
+        keeps the copy-scan path regardless (the operator forced it).
+        Returns the demoted cache keys."""
+        from ..parallel import resident as resident_lib
+        self.resident_budget = int(budget)
+        return resident_lib.enforce_budget(self.resident_pool,
+                                           self.resident_budget)
 
     # -- setup -----------------------------------------------------------
 
@@ -262,6 +295,38 @@ class Trainer:
                 self.model, view, self.num_classes)
         return self._eval_steps[view]
 
+    def _build_resident_batch_step(self):
+        """The resident-gather feed's PER-BATCH execution form: one
+        jitted dispatch = on-device gather from the pinned pool + the
+        chained PRNG split + the train step.  Key consumption and batch
+        bytes are exactly the epoch scan's (and the host path's), so all
+        forms produce the same batch stream; this form exists because
+        XLA:CPU executes large conv bodies INSIDE ``lax.scan`` several
+        times slower than the same ops dispatched directly (measured 6x
+        on ResNet-18 at 112px), while on accelerators the scan's
+        one-dispatch-per-epoch wins.  Compiles once per experiment (the
+        pool shape is constant and the index vector is [batch]-sized —
+        no step bucketing involved)."""
+        train_step = self._train_step
+        mesh = self.mesh
+
+        @functools.partial(jax.jit, static_argnames=("view",),
+                           donate_argnums=(0, 5))
+        def resident_batch_step(state, images, labels, ids, mask, key,
+                                lr, class_weights, view):
+            batch = {
+                "image": jax.lax.with_sharding_constraint(
+                    images[ids], mesh_lib.batch_sharding(mesh)),
+                "label": labels[ids],
+                "mask": mask,
+            }
+            new_key, sub = jax.random.split(key)
+            new_state, loss, gnorm = train_step(state, batch, sub, lr,
+                                                class_weights, view=view)
+            return new_state, new_key, loss, gnorm
+
+        return resident_batch_step
+
     def _build_epoch_scan(self):
         """One jitted call = one full epoch over device-resident data.
 
@@ -327,6 +392,119 @@ class Trainer:
     def bucket_steps(cls, steps_real: int) -> int:
         from ..pool import bucket_size
         return bucket_size(steps_real, floor=cls.STEP_BUCKET)
+
+    # -- the train-feed hierarchy ----------------------------------------
+
+    def resolve_train_feed(self, train_set: Dataset,
+                           labeled_idxs: np.ndarray,
+                           batch_hook=None) -> str:
+        """Pick one feed for a whole fit (resolved ONCE, at fit start —
+        a feed must never change mid-fit or a warm round would recompile):
+
+          "resident"      on-device gather of labeled indices from the
+                          SAME pinned pool that serves scoring and
+                          evaluation — zero host image copies, augment
+                          on device inside the epoch scan;
+          "resident_copy" the legacy labeled-subset upload + epoch scan
+                          (now the special case of resident-gather for
+                          pools whose full array doesn't fit the budget
+                          while the labeled slice does);
+          "host_prefetch" multi-worker gather/decode behind the
+                          double-buffered device prefetch
+                          (data/pipeline.train_feed_batches);
+          "host_serial"   the plain per-batch gather->shard->step loop
+                          (always the path under a VAAL batch_hook,
+                          which consumes host-ordered sharded batches).
+
+        Every feed yields a bit-identical batch stream at the same rng /
+        PRNG-key state (tests/test_trainer_parallel.py) — this decision
+        is throughput-only.  cfg.train_feed forces a leg ("resident" /
+        "host"); "auto" walks the hierarchy top-down.  cfg.device_resident
+        keeps its meaning as the epoch-scan gate: False pins the host
+        leg, None applies the measured auto rule (always on accelerators,
+        >= 2048 labeled rows on CPU — the scan's extra compile must
+        amortize)."""
+        from ..parallel import resident as resident_lib
+        mode = getattr(self.cfg, "train_feed", "auto") or "auto"
+        if mode not in ("auto", "resident", "host"):
+            # Fail fast on the first fit: argparse guards the CLI, but a
+            # programmatic config with a typo'd mode must not silently
+            # train on a different feed than the caller believes.
+            raise ValueError(
+                f"train_feed={mode!r} is not one of 'auto'/'resident'/"
+                "'host'")
+        images = getattr(train_set, "images", None)
+        in_mem = isinstance(images, np.ndarray)
+        hook_free = batch_hook is None
+
+        prefetched = hook_free and (self._feed_workers() > 0
+                                    or self.cfg.loader_tr.prefetch > 0)
+        host = "host_prefetch" if prefetched else "host_serial"
+
+        scan_possible = hook_free and in_mem \
+            and self.cfg.device_resident is not False
+        resident_ok = scan_possible and resident_lib.eligible(
+            train_set, self.resident_budget, cache=self.resident_pool)
+        if mode == "resident":
+            if resident_ok:
+                return "resident"
+            self.logger.warning(
+                "train_feed=resident requested but the pool cannot pin "
+                "(disk-backed, batch_hook, device_resident=False, or "
+                "over the resident budget); falling back down the feed "
+                "hierarchy")
+            mode = "auto"
+        if mode == "host":
+            return host
+        # auto: the epoch scan must be worthwhile before any resident leg
+        # engages (on CPU a small fit's scan compile costs more than it
+        # saves; on accelerators per-batch h2d + dispatch always loses).
+        on_accel = self.mesh.devices.flat[0].platform != "cpu"
+        scan_worthwhile = scan_possible and (
+            self.cfg.device_resident is True
+            or (self.cfg.device_resident is None
+                and (on_accel or len(labeled_idxs) >= 2048)))
+        if scan_worthwhile:
+            if resident_ok:
+                return "resident"
+            bs = self.padded_batch_size(self.cfg.loader_tr.batch_size)
+            copy_bytes = (self.bucket_steps(num_batches(len(labeled_idxs),
+                                                        bs)) * bs
+                          * int(np.prod(train_set.images.shape[1:]))
+                          * train_set.images.itemsize)
+            if train_set.images.nbytes <= 2 ** 31 and (
+                    # Explicit device_resident=True keeps its legacy
+                    # meaning (force the scan path regardless of the
+                    # residency budget); under AUTO the private labeled
+                    # copy is HBM like any pinned array and must fit the
+                    # shared budget — after a mid-run demote, "fall back
+                    # to the host path" must mean the host path, not an
+                    # unaccounted re-upload.
+                    self.cfg.device_resident is True
+                    or resident_lib.pinned_bytes(self.resident_pool)
+                    + copy_bytes <= self.resident_budget):
+                return "resident_copy"
+        return host
+
+    def _feed_workers(self) -> int:
+        """Gather/decode worker threads for the host train feed:
+        TrainConfig.feed_workers, deferring to the train loader's
+        num_workers (the reference DataLoader row) when unset.  ONE
+        resolution shared by the feed decision and the feed itself."""
+        if self.cfg.feed_workers is not None:
+            return int(self.cfg.feed_workers)
+        return int(self.cfg.loader_tr.num_workers)
+
+    def _resident_feed_arrays(self, train_set: Dataset):
+        """The resident-gather feed's arrays: the SAME pinned (pool,
+        labels) pair scoring and evaluation use — one upload for the
+        whole experiment, no second HBM copy, and NOTHING host-side
+        beyond the shared-cache lookup.  The zero-host-copy invariant is
+        enforced statically: scripts/trace_lint.py forbids any np.* or
+        .gather() materialization inside this function."""
+        from ..parallel import resident as resident_lib
+        return resident_lib.pool_arrays(self.resident_pool, train_set,
+                                        self.mesh)
 
     def _device_resident_arrays(self, train_set: Dataset,
                                 labeled_idxs: np.ndarray, batch_size: int):
@@ -422,6 +600,29 @@ class Trainer:
         metric_cb("step_time_ms_p99", round(p99 * 1000.0, 3), tele_step)
         metric_cb("imgs_per_sec", round(n_images / wall, 1), tele_step)
 
+    def _emit_feed_telemetry(self, metric_cb, tele_step: int,
+                             host_waits: List[float],
+                             train_wall: float) -> None:
+        """Per-epoch feed-boundedness: ``feed_stall_frac`` (fraction of
+        the epoch's train wall spent blocked on the host feed) and
+        ``host_wait_ms_p50`` (per-batch wait median) — a host-bound
+        epoch reads off ``status``/the sink without a profiler.  The
+        resident/epoch-scan legs have NO host feed and emit explicit
+        zeros: "the feed costs nothing" is a statement, not an absence.
+        Both also land in ``last_feed`` for the driver's round gauges
+        (Prometheus) and bench attribution."""
+        from ..telemetry.runtime import percentile
+        if host_waits and train_wall > 0:
+            stall = min(1.0, sum(host_waits) / train_wall)
+            p50_ms = percentile(host_waits, 0.50) * 1000.0
+        else:
+            stall, p50_ms = 0.0, 0.0
+        self.last_feed["feed_stall_frac"] = round(stall, 4)
+        self.last_feed["host_wait_ms_p50"] = round(p50_ms, 3)
+        if metric_cb is not None:
+            metric_cb("feed_stall_frac", round(stall, 4), tele_step)
+            metric_cb("host_wait_ms_p50", round(p50_ms, 3), tele_step)
+
     # -- class weights ---------------------------------------------------
 
     def class_weights(self, labels: np.ndarray) -> np.ndarray:
@@ -447,8 +648,8 @@ class Trainer:
         variables = state.variables
 
         from ..parallel import resident as resident_lib
-        if (resident_lib.eligible(dataset, self.resident_budget)
-                or resident_lib.cached(self.resident_pool, dataset)):
+        if resident_lib.eligible(dataset, self.resident_budget,
+                                 cache=self.resident_pool):
             # Device-resident path: on-device row gather per batch, count
             # totals accumulated ON DEVICE (one host fetch at the end) so
             # async dispatch pipelines the whole eval pass; see
@@ -529,31 +730,48 @@ class Trainer:
         state = self.reinit_optimizer(state)
         bs = self.padded_batch_size(self.cfg.loader_tr.batch_size)
 
-        # Device-resident epochs: when the labeled subset is an in-memory
-        # array that fits in HBM and no per-batch hook needs host batches,
-        # upload it once and run each epoch as ONE jitted scan — identical
-        # numerics (tests/test_trainer_parallel.py), zero per-batch
-        # dispatch.  Auto mode: on accelerators ALWAYS (per-batch h2d +
-        # dispatch latency dominates small-round epochs, and the row/step
-        # bucketing means one compile serves consecutive AL rounds); on
-        # CPU only once the labeled set is large enough to amortize the
-        # scan's extra XLA compile.
-        dr_possible = (batch_hook is None
-                       and isinstance(getattr(train_set, "images", None),
-                                      np.ndarray)
-                       and train_set.images.nbytes <= 2 ** 31)
-        on_accel = self.mesh.devices.flat[0].platform != "cpu"
-        use_dr = dr_possible and (
-            self.cfg.device_resident is True
-            or (self.cfg.device_resident is None
-                and (on_accel or len(labeled_idxs) >= 2048)))
-        if use_dr:
+        # The train feed, resolved ONCE for the whole fit (DESIGN.md §2a:
+        # resident-gather > prefetched-host > serial-host).  On the
+        # resident legs each epoch is ONE jitted scan whose per-step
+        # on-device gather + augment reproduce the host stream bit for
+        # bit (tests/test_trainer_parallel.py); "resident" draws from the
+        # SAME pinned pool scoring/evaluation use (zero host image
+        # copies), "resident_copy" from a private labeled-subset upload.
+        feed = self.resolve_train_feed(train_set, labeled_idxs, batch_hook)
+        # Execution form for the resident feed: one scan dispatch per
+        # epoch on accelerators (and when the scan is explicitly forced),
+        # one jitted gather+step dispatch per batch on CPU meshes —
+        # XLA:CPU runs conv bodies inside lax.scan several times slower
+        # than directly-dispatched ops (_build_resident_batch_step), and
+        # the per-batch form also skips the step-bucket padding entirely.
+        scan_form = (self.mesh.devices.flat[0].platform != "cpu"
+                     or self.cfg.device_resident is True)
+        use_scan = (feed == "resident_copy"
+                    or (feed == "resident" and scan_form))
+        self.last_feed = {"source": feed, "feed_stall_frac": None,
+                          "host_wait_ms_p50": None,
+                          "form": ("scan" if use_scan else
+                                   "step" if feed == "resident" else
+                                   "loop")}
+        feed_map = None
+        if feed == "resident":
+            # Local epoch-matrix positions -> GLOBAL pool rows.  int32:
+            # resident pools are bounded by HBM, far under 2^31 rows.
+            feed_map = np.asarray(labeled_idxs, dtype=np.int32)
+            dr_images, dr_labels = self._resident_feed_arrays(train_set)
+        elif feed == "resident_copy":
             dr_images, dr_labels = self._device_resident_arrays(
                 train_set, labeled_idxs, bs)
-            if self._epoch_scan is None:
-                self._epoch_scan = self._build_epoch_scan()
-                tele_runtime.get_run().register_jit(
-                    f"epoch_scan@{id(self):x}", self._epoch_scan)
+        if use_scan and self._epoch_scan is None:
+            self._epoch_scan = self._build_epoch_scan()
+            tele_runtime.get_run().register_jit(
+                f"epoch_scan@{id(self):x}", self._epoch_scan)
+        if (feed == "resident" and not use_scan
+                and self._resident_batch_step is None):
+            self._resident_batch_step = self._build_resident_batch_step()
+            tele_runtime.get_run().register_jit(
+                f"resident_batch_step@{id(self):x}",
+                self._resident_batch_step)
 
         best_perf, best_epoch, es_count = 0.0, 0, 0
         best_variables = None  # device tree after an improvement this fit
@@ -659,9 +877,15 @@ class Trainer:
             # history is materialized to floats right before returning;
             # mid-fit history entries hold live device arrays, so history
             # must never be added to the fit-state payload as-is.
-            if use_dr:
+            host_waits: List[float] = []
+            if use_scan:
                 idx_mat, mask_mat, valid, steps_real = \
                     self._epoch_index_matrix(len(labeled_idxs), bs, rng)
+                if feed_map is not None:
+                    # Resident-gather: the SAME shuffled layout the host
+                    # path commits, re-expressed as global pool rows —
+                    # index math only, never an image byte.
+                    idx_mat = feed_map[idx_mat]
                 state, key, losses, gnorms = self._epoch_scan(
                     state, dr_images, dr_labels, jnp.asarray(idx_mat),
                     jnp.asarray(mask_mat), jnp.asarray(valid), key, lr,
@@ -669,18 +893,66 @@ class Trainer:
                 epoch_loss = jnp.sum(losses) / steps_real
                 epoch_gnorm = jnp.sum(gnorms) / steps_real
                 steps_run = steps_real
-            else:
+            elif feed == "resident":
+                # Per-batch execution form: the SAME shuffled global
+                # layout (batch_index_lists consumes the rng exactly
+                # like the scan's _epoch_index_matrix and the host
+                # path), each batch one jitted on-device gather + step —
+                # the only h2d per step is the [batch] index vector.
                 losses, gnorms = [], []
                 t_step = time.perf_counter() if collect else 0.0
+                for b in batch_index_lists(labeled_idxs, bs,
+                                           shuffle=True, rng=rng):
+                    ids, mask = padded_batch_layout(b, bs)
+                    small = mesh_lib.replicate(
+                        (ids.astype(np.int32), mask), self.mesh)
+                    state, key, loss, gnorm = self._resident_batch_step(
+                        state, dr_images, dr_labels, *small, key, lr,
+                        class_weights, view=train_set.view)
+                    losses.append(loss)
+                    gnorms.append(gnorm)
+                    if collect:
+                        now = time.perf_counter()
+                        step_times.append(now - t_step)
+                        t_step = now
+                        rt.tick(epoch=epoch, step=len(losses))
+                epoch_loss = (jnp.mean(jnp.stack(losses))
+                              if losses else 0.0)
+                epoch_gnorm = (jnp.mean(jnp.stack(gnorms))
+                               if gnorms else 0.0)
+                steps_run = len(losses)
+            else:
+                losses, gnorms = [], []
+                workers = self._feed_workers()
+                # host_prefetch: worker-threaded gather/decode behind the
+                # double-buffered device prefetch — the loop below then
+                # receives already-sharded device batches and host_wait
+                # measures pure feed stall.  host_serial (always under a
+                # batch_hook): the classic gather->shard->step loop.
+                put = ((lambda b: mesh_lib.shard_batch(b, self.mesh))
+                       if feed == "host_prefetch" else None)
                 # Host-side s2d only without a batch_hook: VAAL's hook
                 # feeds the same sharded batch to its 3-channel VAE.
-                for batch in iterate_batches(
-                        train_set, labeled_idxs, bs, shuffle=True, rng=rng,
-                        num_threads=self.cfg.loader_tr.num_workers,
-                        prefetch=self.cfg.loader_tr.prefetch,
-                        local=mesh_lib.process_local_rows(self.mesh, bs),
-                        s2d=self._host_s2d and batch_hook is None):
-                    sharded = mesh_lib.shard_batch(batch, self.mesh)
+                feed_iter = iter(train_feed_batches(
+                    train_set, labeled_idxs, bs, rng=rng, shuffle=True,
+                    num_workers=workers,
+                    prefetch=self.cfg.loader_tr.prefetch,
+                    local=mesh_lib.process_local_rows(self.mesh, bs),
+                    s2d=self._host_s2d and batch_hook is None,
+                    put=put, depth=self.cfg.loader_tr.prefetch))
+                t_step = time.perf_counter() if collect else 0.0
+                while True:
+                    t_wait = time.perf_counter() if collect else 0.0
+                    item = next(feed_iter, None)
+                    if item is None:
+                        break
+                    if collect:
+                        # Time blocked on the feed (gather/decode on the
+                        # serial leg, queue wait on the prefetched one):
+                        # the numerator of feed_stall_frac.
+                        host_waits.append(time.perf_counter() - t_wait)
+                    sharded = (item if put is not None
+                               else mesh_lib.shard_batch(item, self.mesh))
                     state, key, loss, gnorm = self._chained_train_step(
                         state, sharded, key, lr, class_weights,
                         view=train_set.view)
@@ -772,7 +1044,10 @@ class Trainer:
                     t_train_end - t_epoch0,
                     time.perf_counter() - t_epoch0, use_es,
                     steps_run, step_times)
-                rt.tick(epoch=epoch)
+                self._emit_feed_telemetry(
+                    metric_cb, round_idx * (n_epoch + 1) + epoch,
+                    host_waits, t_train_end - t_epoch0)
+                rt.tick(epoch=epoch, feed=feed)
             history.append(record)
             if use_es and es_count > es_patience:
                 # Break BEFORE the periodic fit-state save: a state whose

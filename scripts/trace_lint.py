@@ -17,6 +17,15 @@ routing statically, invoked from tier-1 (tests/test_telemetry.py):
   4. ``jax.profiler.TraceAnnotation`` stays behind ``tracing.annotate``
      (one device-naming convention; the whitelist is tracing.py).
 
+It also enforces the trainer's ZERO-HOST-COPY feed invariant (the
+resident-gather train feed, DESIGN.md §2a):
+
+  5. ``train/trainer.py`` must define every function in
+     ``RESIDENT_FEED_FNS``, and none of them may materialize image data
+     on the host — no ``np.*`` usage, no ``.gather(`` call, no
+     ``.asarray``/``.concatenate`` — so "train batches never touch the
+     host" is a statically-checked property, not just a benched one.
+
 Stdlib only; exits 0 clean / 1 with findings on stderr.
 """
 
@@ -33,6 +42,15 @@ TRACING = os.path.join(PKG, "utils", "tracing.py")
 
 # The one module allowed to touch jax.profiler.TraceAnnotation directly.
 ANNOTATION_WHITELIST = {TRACING}
+
+TRAINER = os.path.join(PKG, "train", "trainer.py")
+# The trainer functions that ARE the resident-gather feed path: each must
+# exist (renaming one away would silently drop the enforcement) and must
+# never materialize image arrays on the host.
+RESIDENT_FEED_FNS = ("_resident_feed_arrays", "_build_resident_batch_step")
+# Host-materialization markers forbidden inside those functions.
+_HOST_COPY_CALLS = {"gather", "asarray", "concatenate", "ascontiguousarray",
+                    "stack", "copy"}
 
 
 def _py_files():
@@ -123,6 +141,47 @@ def check() -> list:
                         "annotate so device spans keep one naming "
                         "convention")
 
+    # 5. The resident-gather train feed stays zero-host-copy.
+    problems.extend(check_resident_feed())
+
+    return problems
+
+
+def check_resident_feed(trainer_path: str = TRAINER) -> list:
+    """The zero-host-copy invariant, statically: the trainer functions in
+    RESIDENT_FEED_FNS may look up the shared device cache and do index
+    math, but any ``np.`` reference or host-materializing call
+    (``.gather``/``.asarray``/``.concatenate``/...) inside them means an
+    image array crossed back to the host on the resident feed path."""
+    problems = []
+    rel = os.path.relpath(trainer_path, REPO)
+    try:
+        with open(trainer_path) as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError) as e:
+        return [f"{rel}: unreadable for the resident-feed check ({e})"]
+    fns = {node.name: node for node in ast.walk(tree)
+           if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name in RESIDENT_FEED_FNS:
+        fn = fns.get(name)
+        if fn is None:
+            problems.append(
+                f"{rel}: resident-feed function {name} not found — the "
+                "zero-host-copy enforcement has nothing to check")
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == "np":
+                problems.append(
+                    f"{rel}:{node.lineno}: {name} references np — the "
+                    "resident train feed must never materialize image "
+                    "arrays on the host")
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_COPY_CALLS:
+                problems.append(
+                    f"{rel}:{node.lineno}: {name} calls "
+                    f".{node.func.attr}() — host materialization on the "
+                    "resident train feed path")
     return problems
 
 

@@ -51,7 +51,9 @@ class TestDegradedModeLine:
         lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
         assert lines, "bench printed nothing to stdout"
         line = lines[-1]
-        assert len(line.encode()) <= 1500  # the harness-tail bound
+        # The harness-tail bound: ~2000 bytes of stdout tail, nothing on
+        # stdout but this line — 1600 leaves 400 bytes of slop margin.
+        assert len(line.encode()) <= 1600
         out = json.loads(line)  # strict: NaN/Inf tokens would raise
         for key in REQUIRED_KEYS:
             assert key in out, f"missing {key!r} in {sorted(out)}"
@@ -63,6 +65,9 @@ class TestDegradedModeLine:
         # backend it appears as an explicit failure on the degraded
         # line, exactly like every offline phase.
         assert "serve_throughput" in out["failed"]
+        # ... and so does the train-feed comparison phase: the feed
+        # hierarchy's numbers must never silently vanish from the line.
+        assert "imagenet_train_feed" in out["failed"]
         # The full evidence file landed in the REDIRECTED dir and is
         # itself strict-parseable.
         assert out["evidence"] == str(tmp_path / "bench_evidence.json")
@@ -103,6 +108,51 @@ class TestDegradedModeLine:
         # The degraded-mode line carries the step-time percentiles.
         assert phase["step_time_ms_p50"] == pytest.approx(48.2)
         assert phase["step_time_ms_p99"] == pytest.approx(61.7)
+
+    def test_feed_fields_and_datapath_rename_ride_the_line(self, tmp_path):
+        """The feed-hierarchy numbers (imagenet_train_feed, feed_source/
+        feed_stall_frac on train + al_round phases) and the datapath's
+        renamed warm field (warm_memmap_ips, nee ips_warm — the cold/warm
+        naming-trap fix) must all surface on the compact line."""
+        base = {"n_chips": 1, "device_kind": "cpu", "platform": "cpu",
+                "captured_utc": "2026-01-01T00:00:00Z"}
+        cache = {
+            "imagenet_train_feed": dict(
+                base, phase="imagenet_train_feed", ips=5000.0,
+                ips_per_chip=5000.0, batch_per_chip=64,
+                feed_source="resident", feed_stall_frac=0.02,
+                ips_resident=5000.0, ips_host_prefetch=900.0,
+                ips_host_serial=400.0),
+            "imagenet_datapath": dict(
+                base, phase="imagenet_datapath", ips=348.6,
+                ips_per_chip=348.6, batch_per_chip=128,
+                # Canonical name ONLY (no deprecated ips_warm): the
+                # fallback must not be required for the line to carry it.
+                cold_populate_ips=348.6, warm_memmap_ips=157.7,
+                deprecated_keys={"ips_warm": "renamed warm_memmap_ips"}),
+            "al_round_cifar": dict(
+                base, phase="al_round_cifar", ips=400.0,
+                ips_per_chip=400.0, batch_per_chip=128,
+                round_sec_warm=22.0, round_sec_cold=80.0,
+                feed_source="resident", feed_stall_frac=0.01),
+        }
+        (tmp_path / "bench_cache.json").write_text(json.dumps(cache))
+        proc = _run_bench(tmp_path)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        feed = out["phases"]["imagenet_train_feed"]
+        assert feed["feed"] == "resident"
+        assert feed["stall"] == pytest.approx(0.02)
+        # The hierarchy comparison, positionally: [resident,
+        # host_prefetch, host_serial] img/s.
+        assert feed["legs"] == [pytest.approx(5000.0),
+                                pytest.approx(900.0),
+                                pytest.approx(400.0)]
+        dp = out["phases"]["imagenet_datapath"]
+        assert dp["warm_ips"] == pytest.approx(157.7)
+        rd = out["phases"]["al_round_cifar"]
+        assert rd["feed"] == "resident"
+        assert rd["stall"] == pytest.approx(0.01)
 
     def test_state_dir_redirect_leaves_repo_files_alone(self, tmp_path):
         """The redirect itself: nothing in the repo root may be touched

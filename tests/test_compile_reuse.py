@@ -127,6 +127,120 @@ class TestEpochScanCompileReuse:
         assert Trainer.bucket_steps(157) == 160
 
 
+class TestResidentBudgetDemotion:
+    def test_mid_run_shrink_demotes_cleanly_without_recompile(self):
+        """Budget-sharing: shrinking the resident budget mid-run demotes
+        the pinned pool LRU-first (parallel/resident.enforce_budget) and
+        the NEXT fit falls back to the host feed — with no batch-shape
+        change and ZERO new XLA compiles, because the host step was
+        already compiled at the same bucketed shapes."""
+        import dataclasses
+        from helpers import TinyClassifier, tiny_train_config
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.parallel import resident as resident_lib
+        from active_learning_tpu.train.trainer import Trainer
+
+        train_set, _, al_set = get_data_synthetic(n_train=96, n_test=16)
+        cfg = dataclasses.replace(tiny_train_config(batch_size=16),
+                                  train_feed="auto", device_resident=None)
+        mesh = mesh_lib.make_mesh()
+        trainer = Trainer(TinyClassifier(), cfg, mesh, 4)
+
+        def fit_round(seed, feed=None):
+            c = cfg if feed is None else dataclasses.replace(
+                cfg, train_feed=feed)
+            trainer.cfg = c
+            state = trainer.init_state(jax.random.PRNGKey(seed),
+                                       train_set.gather(np.arange(2)))
+            rng = np.random.default_rng(seed)
+            labeled = np.sort(rng.choice(96, 60, replace=False))
+            return trainer.fit(state, train_set, labeled, al_set,
+                               np.arange(90, 96), n_epoch=2,
+                               es_patience=0, rng=rng)
+
+        fit_round(0, feed="host")      # warm the host step's executable
+        fit_round(1, feed="resident")  # pin + warm the resident step
+        assert trainer.last_feed["source"] == "resident"
+        assert resident_lib.pinned_bytes(trainer.resident_pool) > 0
+        chained = _cache_size(trainer._chained_train_step)
+        resident_step = _cache_size(trainer._resident_batch_step)
+
+        demoted = trainer.set_resident_budget(1)  # mid-run shrink
+        assert demoted and not trainer.resident_pool.get("images")
+
+        fit_round(2)  # auto now resolves down the hierarchy
+        assert trainer.last_feed["source"].startswith("host")
+        # No shape change, no recompile: both executables' caches are
+        # exactly where the warm-up left them.
+        assert _cache_size(trainer._chained_train_step) == chained
+        assert _cache_size(trainer._resident_batch_step) == resident_step
+
+    def test_shared_budget_accounting_and_lru_order(self):
+        """eligible() charges the WHOLE cache against one budget, the
+        al/train views' shared storage counts once, and eviction walks
+        least-recently-used first."""
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.parallel import resident as resident_lib
+
+        train_set, test_set, al_set = get_data_synthetic(
+            n_train=64, n_test=64, num_classes=4, image_size=8)
+        mesh = mesh_lib.make_mesh()
+        cache = {}
+        resident_lib.pool_arrays(cache, al_set, mesh)
+        one = resident_lib.pinned_bytes(cache)
+        assert one == al_set.images[:64].nbytes
+        # The train view shares storage: same entry, same bytes.
+        resident_lib.pool_arrays(cache, train_set, mesh)
+        assert resident_lib.pinned_bytes(cache) == one
+        # A second array is only eligible if it fits ALONGSIDE the first.
+        assert resident_lib.eligible(test_set, 2 * one, cache=cache)
+        assert not resident_lib.eligible(test_set, one + 1, cache=cache)
+        # An already-pinned pool stays eligible under any budget.
+        assert resident_lib.eligible(al_set, 1, cache=cache)
+        resident_lib.pool_arrays(cache, test_set, mesh)
+        # Touch the al pool so the TEST set is now least-recently-used.
+        resident_lib.pool_arrays(cache, al_set, mesh)
+        demoted = resident_lib.enforce_budget(cache, one)
+        assert demoted == [(id(test_set.images), 64)]
+        assert resident_lib.cached(cache, al_set)
+        assert not resident_lib.cached(cache, test_set)
+
+    def test_auto_budget_adds_pinned_back_as_total_cap(self):
+        """A live-headroom auto budget has already-pinned pools netted
+        OUT of bytes_in_use's headroom; the shared eligible() accounting
+        charges them against the budget as a TOTAL cap, so the refresh
+        must add them back — otherwise every pinned pool is billed
+        twice and a second pool that actually fits gets rejected."""
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.parallel import resident as resident_lib
+
+        _, test_set, al_set = get_data_synthetic(
+            n_train=64, n_test=64, num_classes=4, image_size=8)
+        cache = {}
+        resident_lib.pool_arrays(cache, al_set, mesh_lib.make_mesh())
+        pinned = resident_lib.pinned_bytes(cache)
+        need = test_set.images[:64].nbytes
+        reserve = resident_lib.AUTO_RESERVE_BYTES
+        # Live stats where headroom (net of the pinned pool) covers the
+        # second pool exactly: bytes_in_use INCLUDES the pinned bytes.
+        stats = {"bytes_limit": reserve + pinned + need + 1024,
+                 "bytes_in_use": pinned}
+        budget = resident_lib.resolve_budget(None, stats=stats,
+                                             cache=cache)
+        # Total cap = headroom + pinned, so the second pool is eligible
+        # alongside the first under the shared accounting.
+        assert budget == need + 1024 + pinned
+        assert resident_lib.eligible(test_set, budget, cache=cache)
+        # Without the add-back the same scenario double-counts and
+        # rejects it.
+        assert not resident_lib.eligible(
+            test_set, resident_lib.resolve_budget(None, stats=stats),
+            cache=cache)
+
+
 class TestCompilationCacheConfig:
     def test_driver_enables_persistent_cache(self, tmp_path, monkeypatch):
         from active_learning_tpu.experiment import driver

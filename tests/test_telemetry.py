@@ -501,6 +501,37 @@ class TestTraceLint:
         problems = lint.check()
         assert any("defines its own phase_timer" in p for p in problems)
 
+    def test_lint_flags_host_copies_on_resident_feed_path(self, tmp_path):
+        """The zero-host-copy invariant (DESIGN.md §2a): a resident-feed
+        function that materializes image arrays on the host (np.*, a
+        .gather()/.asarray() call) must fail the lint, and deleting the
+        function entirely must too — the enforcement cannot be renamed
+        away."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_lint", os.path.join(REPO, "scripts", "trace_lint.py"))
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+
+        bad = tmp_path / "trainer.py"
+        bad.write_text(
+            "import numpy as np\n"
+            "def _resident_feed_arrays(self, train_set):\n"
+            "    rows = np.asarray(train_set.gather(self.idxs))\n"
+            "    return rows, None\n")
+        problems = lint.check_resident_feed(str(bad))
+        assert any("references np" in p for p in problems)
+        assert any(".gather()" in p for p in problems)
+
+        empty = tmp_path / "empty_trainer.py"
+        empty.write_text("def unrelated():\n    pass\n")
+        problems = lint.check_resident_feed(str(empty))
+        assert any("not found" in p for p in problems)
+
+        # The REAL trainer is clean (also covered by the subprocess run
+        # above, but pinned here against the specific check).
+        assert lint.check_resident_feed() == []
+
 
 class TestSatelliteFixes:
     def test_setup_logging_appends_on_resume(self, tmp_path):

@@ -252,6 +252,184 @@ class TestDeviceResidentEpochs:
         assert len(seen) > 0  # hook ran => host path was used
 
 
+class TestResidentGatherFeed:
+    """The resident-gather train feed (DESIGN.md §2a): train batches are
+    on-device gathers of labeled indices from the SAME pinned pool that
+    serves scoring/evaluation — zero host image copies, and a batch
+    stream bit-identical to every other feed at the same seeds."""
+
+    def _fit(self, cfg, n_labeled=83, seed=6, pool=None):
+        import dataclasses as dc
+        if pool is None:
+            train_set, _, al_set = get_data_synthetic(
+                n_train=90, n_test=16, num_classes=4, image_size=8,
+                seed=seed)
+        else:
+            train_set, al_set = pool
+        mesh = mesh_lib.make_mesh(8)
+        trainer = Trainer(BNClassifier(), cfg, mesh, 4, train_bn=True)
+        state = trainer.init_state(jax.random.PRNGKey(0),
+                                   train_set.gather(np.zeros(1, np.int64)))
+        # n_labeled=83 with batch 16: a PADDED last batch — padding
+        # isolation is part of what must match bit for bit.
+        result = trainer.fit(state, train_set, np.arange(n_labeled),
+                             al_set, np.arange(83, 90), n_epoch=3,
+                             es_patience=0, rng=np.random.default_rng(42))
+        return trainer, result
+
+    @staticmethod
+    def _leaves(result):
+        return jax.tree_util.tree_leaves(
+            jax.tree.map(np.asarray, result.state.variables))
+
+    def test_bitwise_identical_to_copy_scan_and_matches_host(self):
+        import dataclasses as dc
+        base = tiny_train_config()
+        # Scan form (forced by device_resident=True): gathers from the
+        # pinned pool inside the SAME scan body the legacy copy path
+        # runs.  Same gathered bytes, same program => bitwise-identical
+        # parameters.
+        t_scan, scan = self._fit(dc.replace(base, train_feed="resident",
+                                            device_resident=True))
+        assert t_scan.last_feed["source"] == "resident"
+        assert t_scan.last_feed["form"] == "scan"
+        t_copy, copy = self._fit(dc.replace(base, device_resident=True,
+                                            resident_scoring_bytes=0))
+        assert t_copy.last_feed["source"] == "resident_copy"
+        for a, b in zip(self._leaves(scan), self._leaves(copy)):
+            np.testing.assert_array_equal(a, b)
+        # Per-batch form (the CPU-mesh execution form): same batch
+        # stream through a per-batch jitted gather+step.
+        t_res, res = self._fit(dc.replace(base, train_feed="resident"))
+        assert t_res.last_feed["source"] == "resident"
+        assert t_res.last_feed["form"] == "step"
+        for a, b in zip(self._leaves(res), self._leaves(scan)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+        # And the host-batched stream is the same batches through the
+        # same step — numerically identical within fusion-order noise.
+        t_host, host = self._fit(dc.replace(base, device_resident=False))
+        assert t_host.last_feed["source"].startswith("host")
+        assert [h["train_loss"] for h in res.history] == pytest.approx(
+            [h["train_loss"] for h in host.history], rel=1e-5)
+        for a, b in zip(self._leaves(res), self._leaves(host)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_unlabeled_pool_rows_never_leak_into_training(self):
+        """The resident feed gathers from the FULL pool array; rows
+        outside the labeled set must be complete no-ops — two pools
+        identical on the labeled rows but wildly different elsewhere
+        must train to bitwise-identical parameters."""
+        import dataclasses as dc
+        from active_learning_tpu.data.core import ArrayDataset
+        train_set, _, al_set = get_data_synthetic(
+            n_train=90, n_test=16, num_classes=4, image_size=8, seed=6)
+        cfg = dc.replace(tiny_train_config(), train_feed="resident")
+        labeled = np.arange(40)
+        poisoned = train_set.images.copy()
+        poisoned[60:] = 255  # never-labeled rows scrambled
+        pool_a = (train_set, al_set)
+        ds_b = ArrayDataset(poisoned, train_set.targets, 4, train_set.view)
+        pool_b = (ds_b, ds_b.with_view(al_set.view))
+        _, ra = self._fit(cfg, n_labeled=40, pool=pool_a)
+        _, rb = self._fit(cfg, n_labeled=40, pool=pool_b)
+        for a, b in zip(self._leaves(ra), self._leaves(rb)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_one_pinned_pool_serves_training_and_evaluation(self):
+        """After a resident-feed fit, evaluation over the al view (shared
+        storage) reuses the SAME upload — one cache entry, and the
+        budget accounting sees one array's bytes."""
+        import dataclasses as dc
+        from active_learning_tpu.parallel import resident as resident_lib
+        cfg = dc.replace(tiny_train_config(), train_feed="resident")
+        trainer, result = self._fit(cfg)
+        assert len(trainer.resident_pool["images"]) == 1
+        pinned = resident_lib.pinned_bytes(trainer.resident_pool)
+        train_set, _, al_set = get_data_synthetic(
+            n_train=90, n_test=16, num_classes=4, image_size=8, seed=6)
+        # (fresh dataset objects share nothing with the fit's — re-fit on
+        # the trainer's own cached dataset instead)
+        ds = trainer.resident_pool["images"][next(
+            iter(trainer.resident_pool["images"]))][0]
+        trainer.evaluate(result.state, ds, np.arange(8))
+        assert len(trainer.resident_pool["images"]) == 1
+        assert resident_lib.pinned_bytes(trainer.resident_pool) == pinned
+
+    def test_feed_resolution_hierarchy(self):
+        """resolve_train_feed walks resident > resident_copy >
+        host_prefetch > host_serial; a pinned pool auto-selects the
+        resident feed on accelerators (the acceptance invariant)."""
+        import dataclasses as dc
+        from active_learning_tpu.parallel import resident as resident_lib
+        train_set, _, _ = get_data_synthetic(
+            n_train=64, n_test=8, num_classes=4, image_size=8, seed=1)
+        idxs = np.arange(64)
+
+        def mk(**over):
+            return Trainer(TinyClassifier(), dc.replace(
+                tiny_train_config(), **over), mesh_lib.make_mesh(), 4,
+                train_bn=False)
+
+        class FakeDev:
+            platform = "tpu"
+
+        def on_accel(trainer):
+            class FakeMesh:
+                class devices:  # noqa: N801 - mimic ndarray .flat/.size
+                    flat = [FakeDev()]
+                    size = trainer.n_devices
+            trainer.mesh = FakeMesh()
+            return trainer
+
+        # Accelerator + pool fits the budget => resident, even unpinned.
+        assert on_accel(mk()).resolve_train_feed(train_set, idxs) \
+            == "resident"
+        # Pinned pool => resident even when the budget later reads 0
+        # (its bytes are already in HBM — parallel/resident.cached).
+        t = mk()
+        resident_lib.pool_arrays(t.resident_pool, train_set, t.mesh)
+        on_accel(t)  # pin on the REAL mesh, then resolve as-if-on-TPU
+        t.resident_budget = 0
+        assert t.resolve_train_feed(train_set, idxs) == "resident"
+        # Budget 0 (residency disabled / mid-run demote), auto mode: the
+        # resident_copy upload is HBM like any pinned array and is
+        # charged against the SAME budget — the fallback must be the
+        # host path, never an unaccounted re-upload.
+        t2 = on_accel(mk(resident_scoring_bytes=0))
+        t2.resident_budget = 0
+        assert t2.resolve_train_feed(train_set, idxs) == "host_prefetch"
+        # ... while an EXPLICIT device_resident=True keeps its legacy
+        # force-the-scan meaning regardless of the budget.
+        t2f = on_accel(mk(resident_scoring_bytes=0, device_resident=True))
+        t2f.resident_budget = 0
+        assert t2f.resolve_train_feed(train_set, idxs) == "resident_copy"
+        # device_resident=False pins the host leg; prefetch>0 => threaded.
+        assert on_accel(mk(device_resident=False)).resolve_train_feed(
+            train_set, idxs) == "host_prefetch"
+        import dataclasses
+        serial = mk(device_resident=False,
+                    loader_tr=dataclasses.replace(
+                        tiny_train_config().loader_tr, prefetch=0))
+        assert on_accel(serial).resolve_train_feed(train_set, idxs) \
+            == "host_serial"
+        # A batch_hook (VAAL) always takes the serial host leg.
+        assert on_accel(mk()).resolve_train_feed(
+            train_set, idxs, batch_hook=lambda e, b: None) == "host_serial"
+        # CPU auto keeps small fits on the host (scan compile must
+        # amortize); a disk-style dataset (no .images) can never pin.
+        assert mk().resolve_train_feed(train_set, idxs).startswith("host")
+
+    def test_host_prefetch_stream_identical_to_serial(self):
+        import dataclasses as dc
+        base = tiny_train_config()
+        _, pre = self._fit(dc.replace(base, device_resident=False))
+        _, ser = self._fit(dc.replace(
+            base, device_resident=False,
+            loader_tr=dc.replace(base.loader_tr, prefetch=0)))
+        for a, b in zip(self._leaves(pre), self._leaves(ser)):
+            np.testing.assert_array_equal(a, b)
+
+
 class TestImbalancedTrainingWeights:
     """The reference's class-weighted loss (strategy.py:444-457 +
     CrossEntropyLoss(weight=w), strategy.py:352-356)."""
