@@ -186,6 +186,18 @@ class TrainConfig:
     # statistics under eval-mode BN, so the batch size changes throughput
     # only, never a score.
     score_batch_size: Optional[int] = None
+    # Resident-pool LAYOUT over the mesh (DESIGN.md §2b):
+    #   "auto"       — row-sharded whenever the single-process mesh has
+    #                  more than one device (each chip pins rows/ndev of
+    #                  the pool and of every factor matrix, so residency
+    #                  scales with chip count), replicated otherwise
+    #                  (single device, multi-process pods).
+    #   "row"        — force row sharding (downgraded with the same
+    #                  gates as auto where impossible).
+    #   "replicated" — one full copy per chip, the pre-sharding layout.
+    # Scores, train batches, and k-center picks are bit-identical across
+    # layouts (tests/test_pool_sharding.py) — throughput/HBM only.
+    pool_sharding: str = "auto"
     # Keep in-memory datasets resident on device (replicated) for the
     # whole experiment — ONE shared upload serves every round's
     # acquisition scoring AND the per-epoch validation/test evaluation
@@ -375,6 +387,13 @@ class ExperimentConfig:
     # the feed hierarchy (resident-gather > prefetched-host >
     # serial-host); every feed is bit-identical at the same seeds.
     train_feed: Optional[str] = None
+
+    # Resident-pool layout override ("auto"/"replicated"/"row"): None
+    # defers to the arg pool's TrainConfig.pool_sharding, whose default
+    # auto row-shards pool rows over any single-process multi-device
+    # mesh (per-chip residency = rows/ndev).  Scores, batches, and
+    # k-center picks are bit-identical across layouts.
+    pool_sharding: Optional[str] = None
 
     # Host train-feed gather/decode worker threads: None defers to the
     # arg pool (TrainConfig.feed_workers -> loader_tr.num_workers, the

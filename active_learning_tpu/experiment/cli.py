@@ -119,6 +119,16 @@ def get_parser() -> argparse.ArgumentParser:
                         "in HBM and later query/eval passes are on-device "
                         "gathers.  Pass an integer to pin the budget, 0 "
                         "to disable residency.")
+    p.add_argument("--pool_sharding", type=str, default=None,
+                   choices=["auto", "replicated", "row"],
+                   help="resident-pool layout over the mesh: row shards "
+                        "pool rows (and k-center factor matrices) over "
+                        "the data axis so per-chip residency scales "
+                        "1/ndev with chip count; replicated pins one "
+                        "full copy per chip.  auto (the default) picks "
+                        "row on any single-process multi-device mesh.  "
+                        "Scores, batches, and k-center picks are "
+                        "bit-identical across layouts")
     p.add_argument("--train_feed", type=str, default=None,
                    choices=["auto", "resident", "host"],
                    help="train-batch feed: auto picks the top of the "
@@ -204,6 +214,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         stem=args.stem,
         resident_scoring_bytes=args.resident_scoring_bytes,
         train_feed=args.train_feed,
+        pool_sharding=args.pool_sharding,
         feed_workers=args.feed_workers,
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,

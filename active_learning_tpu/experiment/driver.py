@@ -192,6 +192,12 @@ def build_experiment(
         # every leg is bit-identical at the same seeds anyway.
         train_cfg = dataclasses.replace(train_cfg,
                                         train_feed=cfg.train_feed)
+    if cfg.pool_sharding is not None:
+        # --pool_sharding beats the arg pool: the resident layout is a
+        # mesh/HBM deployment choice, and every layout is bit-identical
+        # (scores, batches, picks) anyway.
+        train_cfg = dataclasses.replace(train_cfg,
+                                        pool_sharding=cfg.pool_sharding)
     if cfg.feed_workers is not None:
         train_cfg = dataclasses.replace(train_cfg,
                                         feed_workers=cfg.feed_workers)
@@ -380,7 +386,8 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
                     logger.info(
                         f"Resident pool budget for round {rd}: "
                         f"{budget / 1e9:.2f} GB "
-                        f"({'auto' if strategy.train_cfg.resident_scoring_bytes is None else 'explicit'})")
+                        f"({'auto' if strategy.train_cfg.resident_scoring_bytes is None else 'explicit'}, "
+                        f"per chip, {strategy.trainer.pool_sharding} layout)")
 
                     # Round 0 only queries when there is no initial pool —
                     # with an SSL or transfer-learned init the model can

@@ -21,6 +21,7 @@ import os
 from typing import Any, Dict, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -115,6 +116,85 @@ def make_mesh(num_devices: int = -1,
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Leading (batch) dimension split across the data axis."""
     return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (pool-row) dimension split across the data axis — the
+    resident-pool layout of DESIGN.md §2b.  Identical to batch_sharding
+    in spec; named separately because the two axes mean different
+    things: a batch is transient per step, pool rows are pinned for the
+    experiment and their per-chip HBM cost is ``nbytes / num_devices``.
+    """
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def row_shard_pad(n: int, mesh: Mesh) -> int:
+    """Rows of zero-padding needed to split ``n`` rows evenly over the
+    mesh's data axis (row-sharded uploads pad; consumers only ever
+    index real rows)."""
+    return (-n) % mesh.devices.size
+
+
+def shard_rows(array: np.ndarray, mesh: Mesh,
+               rows: Optional[int] = None) -> Any:
+    """Host array -> device array with the leading (row) axis sharded
+    over the data axis, zero-padded to ``rows`` total rows (default: the
+    array's own length), rounded up to divide evenly.  Built per shard
+    (``jax.make_array_from_callback``): each device's row block is
+    sliced — and only the tail shard's pad materialized — right before
+    its own H2D copy, so the full array never exists padded on host and
+    never lands whole on any single device.  That bounds the transient
+    host overhead at one shard instead of one pool: a 10.5 GB factor
+    matrix costs ~10.5/ndev GB of working copy, not a second 10.5 GB,
+    and 10.5/ndev GB per chip once resident."""
+    n = array.shape[0]
+    total = n if rows is None else int(rows)
+    if total < n:
+        raise ValueError(f"rows={total} < array rows {n}")
+    total += row_shard_pad(total, mesh)
+    tail = array.shape[1:]
+
+    def _shard(index):
+        rs = index[0]
+        lo = rs.start or 0
+        hi = total if rs.stop is None else rs.stop
+        block = np.ascontiguousarray(array[lo:min(hi, n)])
+        short = (hi - lo) - block.shape[0]
+        if short:
+            block = np.concatenate(
+                [block, np.zeros((short, *tail), array.dtype)])
+        return block
+
+    return jax.make_array_from_callback(
+        (total, *tail), row_sharding(mesh), _shard)
+
+
+def owner_rows(arr: Any, idxs: Any, axis: str = DATA_AXIS) -> Any:
+    """Inside a ``shard_map`` body over ``axis``: rows of the shard-local
+    ``arr`` for GLOBAL row indices ``idxs`` [K], assembled from their
+    owning shards by masked psum.  THE exactness-critical primitive of
+    the row-sharded pool, shared by ``resident.sharded_pool_gather`` and
+    the k-center collective backend's center-row gather: exactly one
+    shard owns each global index, non-owners contribute exact zeros, so
+    the sum is the owner's value bit for bit (uint8 included) — the
+    invariant every pick/score/batch-identity test rests on.  Out-of-
+    range indices (pad rows past the last shard) clip to existing rows
+    but are owned by nobody, so they come back as zeros."""
+    rows = arr.shape[0]
+    off = (jax.lax.axis_index(axis) * rows).astype(idxs.dtype)
+    loc = jnp.clip(idxs - off, 0, rows - 1)
+    mine = (idxs >= off) & (idxs < off + rows)
+    picked = jnp.where(mine.reshape((-1,) + (1,) * (arr.ndim - 1)),
+                       arr[loc], jnp.zeros((), arr.dtype))
+    return jax.lax.psum(picked, axis)
+
+
+def is_row_sharded(array: Any) -> bool:
+    """True when a device array's leading axis is split over a mesh axis
+    (the row-sharded pool layout), read off the committed sharding —
+    host-side introspection, never valid on tracers."""
+    spec = getattr(getattr(array, "sharding", None), "spec", None)
+    return bool(spec) and spec[0] is not None
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

@@ -13,9 +13,24 @@ budget, never two.  Entries retain their dataset object: keys include
 id()s, and without the reference a recycled id could silently alias
 another pool's images.
 
+Residency layout (DESIGN.md §2b): a pool pins either REPLICATED (one
+full copy per chip — the pre-sharding behavior, and the only option on
+multi-process meshes today) or ROW-SHARDED (``NamedSharding(mesh,
+P('data', ...))`` over pool rows: each chip holds ``rows/num_devices``,
+so the budget question changes from "does the pool fit on a chip" to
+"does rows/num_devices fit").  ``resolve_sharding`` owns the auto rule
+(row whenever the single-process mesh has >1 device); ``pinned_bytes``
+accounts PER-DEVICE bytes either way, so one budget figure stays a
+per-chip HBM figure across both layouts.  Batches are fetched from a
+row-sharded pool by ``sharded_pool_gather``: each shard contributes its
+owned rows (masked, then psum'd from the owner — batch-sized traffic,
+never pool-sized) and the result lands batch-sharded, exactly where the
+replicated path's sharding constraint put it — so consumers are
+bit-identical across layouts.
+
 Layout of a cache dict:
   cache["images"][(id(images), n)] = (dataset, images_dev, labels_dev)
-  cache["steps"][(id(step_fn), with_labels)] = jitted runner
+  cache["steps"][(id(step_fn), with_labels, sharded)] = jitted runner
   cache["lru"] = [key, ...]  # least-recently-used first (eviction order)
 
 Virtual-CPU-mesh caveat: the N replicas' on-device gathers execute
@@ -30,6 +45,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
 
 from . import mesh as mesh_lib
 from ..utils.logging import get_logger
@@ -107,18 +124,50 @@ def resolve_budget(spec: Optional[int],
     return int(spec)
 
 
+def resolve_sharding(spec: Optional[str], mesh) -> str:
+    """TrainConfig.pool_sharding -> the concrete resident layout,
+    "replicated" or "row".  "auto" (or None): row whenever the mesh has
+    more than one device in a single process — per-chip residency then
+    scales 1/ndev with chip count for free.  Row sharding is gated off
+    multi-process meshes (per-process shard assembly is future work —
+    replicated stays the pod answer) and single-device meshes (sharding
+    over one device is replication with extra steps)."""
+    if spec in (None, "auto"):
+        spec = "row"
+    if spec not in ("replicated", "row"):
+        raise ValueError(
+            f"pool_sharding={spec!r} is not one of 'auto'/'replicated'/"
+            "'row'")
+    if spec == "row" and (mesh is None or mesh.devices.size <= 1
+                          or mesh_lib.is_multiprocess(mesh)):
+        return "replicated"
+    return spec
+
+
+def _per_device_bytes(array: Any) -> int:
+    """HBM bytes one device holds for ``array``: the largest addressable
+    shard (replicated arrays shard as full copies, row-sharded ones as
+    rows/ndev) — so the budget stays a per-chip figure across layouts."""
+    shards = getattr(array, "addressable_shards", None)
+    if shards:
+        return max(int(s.data.nbytes) for s in shards)
+    return int(array.nbytes)
+
+
 def pinned_bytes(cache: Optional[Dict]) -> int:
-    """Total bytes of every image array currently pinned in ``cache``
-    (per-replica logical bytes — replication is per-chip, and the budget
-    is a per-chip HBM figure)."""
+    """Total PER-DEVICE bytes of every image array currently pinned in
+    ``cache`` (replicated entries cost their full size per chip,
+    row-sharded entries rows/ndev — the budget is a per-chip HBM
+    figure either way)."""
     if not cache:
         return 0
-    return sum(int(entry[1].nbytes)
+    return sum(_per_device_bytes(entry[1])
                for entry in cache.get("images", {}).values())
 
 
 def eligible(dataset: Any, max_bytes: int,
-             cache: Optional[Dict] = None) -> bool:
+             cache: Optional[Dict] = None,
+             shard_ways: int = 1) -> bool:
     """In-memory (ArrayDataset-style) and within the byte budget.
 
     With a ``cache``, the budget is shared across every pinned array:
@@ -129,14 +178,22 @@ def eligible(dataset: Any, max_bytes: int,
     and streaming would pay twice (the rule previously restated as
     ``or cached(...)`` at every call site — this is the one spelling).
     Without a cache (direct callers), the old single-array check
-    applies."""
+    applies.
+
+    ``shard_ways``: how many devices a prospective upload would be
+    row-sharded over (1 = replicated).  Under row sharding a chip pins
+    only ceil(rows/ways) rows, so the budget admits pools ~ways times
+    larger — the scale-out the sharded pool exists for."""
     if cache is not None and cached(cache, dataset):
         return True
     images = getattr(dataset, "images", None)
     if not (max_bytes > 0 and isinstance(images, np.ndarray)):
         return False
-    return (pinned_bytes(cache) + images[: len(dataset)].nbytes
-            <= max_bytes)
+    n = len(dataset)
+    ways = max(1, int(shard_ways))
+    row_bytes = int(np.prod(images.shape[1:])) * images.itemsize
+    need = -(-n // ways) * row_bytes  # ceil: covers the shard pad rows
+    return pinned_bytes(cache) + need <= max_bytes
 
 
 def cached(cache: Optional[Dict], dataset: Any) -> bool:
@@ -153,26 +210,87 @@ def cached(cache: Optional[Dict], dataset: Any) -> bool:
     return (id(images), len(dataset)) in cache.get("images", {})
 
 
-def pool_arrays(cache: Dict, dataset: Any, mesh) -> Tuple[Any, Any]:
+def pool_arrays(cache: Dict, dataset: Any, mesh,
+                sharding: str = "replicated") -> Tuple[Any, Any]:
     """(images_dev, labels_dev) for the dataset, uploaded once per
     (underlying array, length) — views sharing storage share the upload.
-    replicate() device_puts EXPLICITLY (transfer-guard friendly).  Every
-    access refreshes the entry's position in the LRU eviction order."""
+    ``sharding`` "row": rows split over the mesh's data axis
+    (mesh_lib.shard_rows — zero-padded to divide evenly; the full array
+    never lands on any single device), "replicated": one copy per chip.
+    The FIRST upload fixes an entry's layout (the mode is a per-
+    experiment deployment choice, resolved once by resolve_sharding);
+    consumers detect it off the array itself (mesh_lib.is_row_sharded).
+    replicate()/shard_rows device_put EXPLICITLY (transfer-guard
+    friendly).  Every access refreshes the entry's position in the LRU
+    eviction order."""
     images = cache.setdefault("images", {})
     n = len(dataset)
     key = (id(dataset.images), n)
     if key not in images:
-        images[key] = (
-            dataset,
-            mesh_lib.replicate(
-                np.ascontiguousarray(dataset.images[:n]), mesh),
-            mesh_lib.replicate(
-                dataset.targets[:n].astype(np.int32), mesh))
+        if sharding == "row" and mesh.devices.size > 1 \
+                and not mesh_lib.is_multiprocess(mesh):
+            # No ascontiguousarray here: shard_rows slices per shard
+            # (and makes each block contiguous itself), so the one big
+            # host copy the replicated path pays is exactly what the
+            # row path avoids.
+            images[key] = (
+                dataset,
+                mesh_lib.shard_rows(dataset.images[:n], mesh),
+                mesh_lib.shard_rows(
+                    dataset.targets[:n].astype(np.int32), mesh))
+        else:
+            images[key] = (
+                dataset,
+                mesh_lib.replicate(
+                    np.ascontiguousarray(dataset.images[:n]), mesh),
+                mesh_lib.replicate(
+                    dataset.targets[:n].astype(np.int32), mesh))
     lru = cache.setdefault("lru", [])
     if key in lru:
         lru.remove(key)
     lru.append(key)
     return images[key][1], images[key][2]
+
+
+def sharded_pool_gather(images, ids, mesh, labels=None):
+    """Rows of a ROW-SHARDED pool for a replicated [batch] index vector,
+    returned batch-sharded — the sharded pool's one batch-fetch
+    primitive, shared by the scoring/eval runners (get_runner) and the
+    trainer's resident-gather feed.  Traceable: shard_map composes under
+    jit and inside lax.scan, so callers embed it in their own jitted
+    steps.
+
+    Mechanics (all inside shard_map over the data axis): every shard
+    masks the batch ids it owns, gathers those rows locally, and a psum
+    assembles the full batch from the owners (non-owners contribute
+    exact zeros — the sum is the owner's bytes, bit for bit, uint8
+    included).  Traffic is batch-sized, never pool-sized; each shard
+    then keeps only ITS slice of the batch, so the output lands exactly
+    where the replicated path's ``with_sharding_constraint(images[ids],
+    batch_sharding)`` put it and every downstream consumer partitions
+    identically — which is why batches are bit-identical across pool
+    layouts (tests/test_pool_sharding.py).
+
+    The global batch must divide the mesh (Trainer.padded_batch_size
+    guarantees it for every caller)."""
+    axis = mesh_lib.DATA_AXIS
+    ndev = mesh.devices.size
+
+    def local_gather(pool, idv):
+        full = mesh_lib.owner_rows(pool, idv, axis)
+        i = jax.lax.axis_index(axis)
+        b_local = idv.shape[0] // ndev
+        return jax.lax.dynamic_slice_in_dim(full, i * b_local, b_local, 0)
+
+    img_spec = P(axis, *([None] * (images.ndim - 1)))
+    if labels is None:
+        return shard_map(local_gather, mesh=mesh,
+                         in_specs=(img_spec, P()), out_specs=img_spec,
+                         check_rep=False)(images, ids)
+    return shard_map(
+        lambda im, lb, idv: (local_gather(im, idv), local_gather(lb, idv)),
+        mesh=mesh, in_specs=(img_spec, P(axis), P()),
+        out_specs=(img_spec, P(axis)), check_rep=False)(images, labels, ids)
 
 
 def enforce_budget(cache: Optional[Dict], max_bytes: int) -> list:
@@ -205,12 +323,17 @@ def enforce_budget(cache: Optional[Dict], max_bytes: int) -> list:
 
 
 def get_runner(cache: Dict, step_fn: Callable, mesh,
-               with_labels: bool = False) -> Callable:
+               with_labels: bool = False, sharded: bool = False) -> Callable:
     """Jitted gather+step over a resident pool: rows are picked out on
     device and constrained to the batch sharding, so each batch costs one
-    tiny [batch]-int32 transfer instead of the image rows."""
+    tiny [batch]-int32 transfer instead of the image rows.  ``sharded``
+    (caller reads it off the entry via mesh_lib.is_row_sharded): the
+    gather goes through sharded_pool_gather — shard-local row pick +
+    owner psum instead of a full-array index — landing the batch in the
+    SAME batch sharding, so the step partitions identically and scores
+    are bit-identical across pool layouts."""
     steps = cache.setdefault("steps", {})
-    key = (id(step_fn), with_labels)
+    key = (id(step_fn), with_labels, bool(sharded))
     if key not in steps:
         batch_sharding = mesh_lib.batch_sharding(mesh)
 
@@ -218,22 +341,25 @@ def get_runner(cache: Dict, step_fn: Callable, mesh,
 
             @jax.jit
             def run(variables, images, labels, ids, mask):
-                batch = {
-                    "image": jax.lax.with_sharding_constraint(
-                        images[ids], batch_sharding),
-                    "label": labels[ids],
-                    "mask": mask,
-                }
+                if sharded:
+                    img, lab = sharded_pool_gather(images, ids, mesh,
+                                                   labels=labels)
+                else:
+                    img = jax.lax.with_sharding_constraint(
+                        images[ids], batch_sharding)
+                    lab = labels[ids]
+                batch = {"image": img, "label": lab, "mask": mask}
                 return step_fn(variables, batch)
         else:
 
             @jax.jit
             def run(variables, images, ids, mask):
-                batch = {
-                    "image": jax.lax.with_sharding_constraint(
-                        images[ids], batch_sharding),
-                    "mask": mask,
-                }
+                if sharded:
+                    img = sharded_pool_gather(images, ids, mesh)
+                else:
+                    img = jax.lax.with_sharding_constraint(
+                        images[ids], batch_sharding)
+                batch = {"image": img, "mask": mask}
                 return step_fn(variables, batch)
 
         steps[key] = run

@@ -350,7 +350,11 @@ class Strategy:
                                    if rb or have_pinned else None),
                 "resident_max_bytes": rb,
                 "host_s2d": getattr(self.model, "stem",
-                                    "default") == "s2d"}
+                                    "default") == "s2d",
+                # The trainer's resolved resident layout (DESIGN.md
+                # §2b): every sampler's scoring pass pins/reads the
+                # shared pool in the SAME layout training does.
+                "pool_sharding": self.trainer.pool_sharding}
 
 
 def register_strategy(name: str):

@@ -114,7 +114,8 @@ class CoresetSampler(Strategy):
         picks = kcenter_greedy(factors, labeled_mask, budget,
                                randomize=self.randomize, rng=self.rng,
                                batch_q=self.cfg.kcenter_batch,
-                               mesh=self.mesh)
+                               mesh=self.mesh,
+                               pool_sharding=self.trainer.pool_sharding)
         selected = idxs_for_coreset[picks]
         assert len(np.unique(selected)) == len(selected), (
             "k-center selected a duplicate index")
@@ -158,6 +159,20 @@ class PartitionedCoresetSampler(CoresetSampler):
         return self._query_partitioned(budget)
 
     def _query_partitioned(self, budget: int) -> Tuple[np.ndarray, int]:
+        if self.cfg.partitions > 1 and self.mesh.devices.size > 1:
+            # Partitioning was the reference's ONLY answer past the
+            # single-chip memory ceiling; the row-sharded pool
+            # (--pool_sharding row, DESIGN.md §2b) scales the
+            # no-partition scan with chip count instead — and unlike
+            # partitioning it keeps the pick sequence identical to the
+            # global greedy.  Kept for parity and statistical variants.
+            self.logger.warning(
+                f"--partitions {self.cfg.partitions} on a "
+                f"{self.mesh.devices.size}-device mesh is a legacy "
+                "fallback: --pool_sharding row shards the factor matrix "
+                "across chips and selects over the FULL pool "
+                "(DESIGN.md §3); partitioning remains only for parity "
+                "and statistical variety")
         _, idxs_labeled, idxs_for_query = self.get_idxs_for_coreset(
             return_sep_idxs=True)
         if len(idxs_for_query) == 0:
@@ -182,7 +197,8 @@ class PartitionedCoresetSampler(CoresetSampler):
             picks = kcenter_greedy(factors, labeled_mask, cur_budget,
                                    randomize=self.randomize, rng=self.rng,
                                    batch_q=self.cfg.kcenter_batch,
-                                   mesh=self.mesh)
+                                   mesh=self.mesh,
+                                   pool_sharding=self.trainer.pool_sharding)
             selected.append(part[picks])
 
         selected = (np.sort(np.concatenate(selected)) if selected

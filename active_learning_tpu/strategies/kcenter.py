@@ -64,11 +64,13 @@ k-means++ D^2 weights, coreset_sampler.py:80-92).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
 from ..pool import bucket_size
@@ -79,6 +81,10 @@ Factors = Tuple[jnp.ndarray, ...]
 # "xla-batched"): bench.py's kcenter phases record it so a capture is
 # attributable to its code path.
 LAST_BACKEND: Optional[str] = None
+
+# Which pool layout the last kcenter_greedy call selected over
+# ("replicated" / "row") — the bench's pool_sharding attribution.
+LAST_SHARDING: Optional[str] = None
 
 # Default q for the batched deterministic greedy: the f32 sublane tile
 # (8), the smallest batch that both cuts scan steps ~8x and fills an MXU
@@ -300,6 +306,385 @@ def _minimax_row(factors: Factors, sqn: jnp.ndarray, block: int = 2048
     return jnp.argmin(row_max)
 
 
+# -- the row-sharded backend (DESIGN.md §2b) -----------------------------
+#
+# The factor matrix is the selection scan's resident state (1.28M x 2048
+# f32 = 10.5 GB for the full ImageNet pool) and used to be replicated
+# per chip, so kcenter_select_maxn could only FIND the single-chip
+# ceiling.  Here the pool axis is row-sharded over the mesh and every
+# per-step pass runs shard-local inside shard_map, with exactly one
+# family of collectives per step:
+#
+#   * distance strips / running-min updates: shard-local [rows/ndev, q];
+#   * the farthest-point argmax / top-q: local reduce, then pmax + a
+#     pmin index tie-break (lowest global index — the argmax rule), or
+#     local top_k + an all_gather of ndev*q candidates (shard-major
+#     order == global index order, so top_k's earliest-position
+#     tie-break IS the replicated lowest-index tie-break);
+#   * each accepted center's factor row: gathered FROM ITS OWNER by a
+#     masked psum (non-owners contribute exact zeros — the sum is the
+#     owner's row bit for bit), never by replicating the matrix.
+#
+# Every reduction is a min/max or a sum of exact zeros plus one value —
+# no rounding anywhere — and each row's matvec stays on one shard, so
+# the pick sequence is BIT-IDENTICAL to the replicated backend (pinned
+# in tests/test_pool_sharding.py).  scripts/trace_lint.py check 6
+# statically forbids these functions from full-pool host
+# materialization (np.* / jax.device_get / .asarray) and from
+# replicating the factor matrix (replicate / replicated_sharding).
+
+# The functions trace_lint check 6 anchors on (renaming one away would
+# silently drop the enforcement): the device tier may never touch np /
+# host fetches at all; the orchestrator may do host index math but
+# never device_get the pool or replicate a row-sharded array.
+SHARDED_SELECTION_FNS = ("_build_sharded_fns", "_kcenter_greedy_sharded")
+
+# Jitted sharded-selection programs, one set per (mesh, n_factors):
+# AL round N+1 reuses round N's executables (shapes are bucketed the
+# same way as the replicated path's — tests/test_compile_reuse.py).
+_SHARDED_JITS: Dict = {}
+
+
+def _build_sharded_fns(mesh, nf: int):
+    """The jitted row-sharded selection programs for one mesh and factor
+    count.  All bodies run inside shard_map over the data axis; factors
+    and the per-row state vectors (sqn / min_dist / selectable /
+    row_max) are sharded over pool rows, scalars and picks replicated."""
+    axis = mesh_lib.DATA_AXIS
+    ndev = mesh.devices.size
+    fspec = tuple(P(axis, None) for _ in range(nf))
+    vec, rep = P(axis), P()
+    repf = tuple(rep for _ in range(nf))
+
+    def _offset(rows: int, dtype=jnp.int32):
+        return (jax.lax.axis_index(axis) * rows).astype(dtype)
+
+    def _owned_or_oob(idxs, rows: int):
+        """Global pick indices -> local positions on the owning shard,
+        everything else mapped PAST the shard (rows) so scatter
+        mode="drop" discards it.  A bare ``idxs - offset`` would go
+        NEGATIVE on shards past the owner, and negative scatter indices
+        wrap python-style BEFORE the drop check — silently zeroing the
+        wrong rows (the bug this helper exists to prevent)."""
+        off = _offset(rows, idxs.dtype)
+        return jnp.where((idxs >= off) & (idxs < off + rows),
+                         idxs - off, rows)
+
+    def _take(factors, sqn, idxs):
+        """Factor rows + self-norms for global ``idxs`` [K], gathered
+        from their owning shards by masked psum (exact: zeros + the
+        owner's value — mesh_lib.owner_rows, the one spelling of the
+        idiom shared with resident.sharded_pool_gather)."""
+        taken = tuple(mesh_lib.owner_rows(f, idxs, axis)
+                      for f in factors)
+        tsqn = mesh_lib.owner_rows(sqn, idxs, axis)
+        return taken, tsqn
+
+    def _argmax_global(vals, n_total: int):
+        """Replicated global argmax index, ties to the LOWEST global
+        index — the full-vector argmax rule, via pmax + pmin."""
+        m_loc = jnp.max(vals)
+        m = jax.lax.pmax(m_loc, axis)
+        cand = jnp.where(m_loc >= m,
+                         jnp.argmax(vals).astype(jnp.int32)
+                         + _offset(vals.shape[0]),
+                         jnp.int32(n_total))
+        return jax.lax.pmin(cand, axis)
+
+    def _topk_global(vals, q: int):
+        """Replicated global (values, indices) top-q.  Local top_k per
+        shard, then top_k over the all_gathered ndev*q candidates —
+        shard-major gather order is global-index order, so equal values
+        resolve to the lowest global index exactly like the replicated
+        top_k."""
+        v, ix = jax.lax.top_k(vals, q)
+        gi = ix.astype(jnp.int32) + _offset(vals.shape[0])
+        av = jax.lax.all_gather(v, axis)
+        ai = jax.lax.all_gather(gi, axis)
+        v2, pos = jax.lax.top_k(av.reshape(-1), q)
+        return v2, ai.reshape(-1)[pos]
+
+    def _strip_min(factors, sqn, crows, csqn, min_dist):
+        """Shard-local [rows/ndev, K] distance strip against K gathered
+        center rows, folded into the running min — the sharded
+        batched_min_dist_update."""
+        d = None
+        for f, r in zip(factors, crows):
+            dd = f @ r.T
+            d = dd if d is None else d * dd
+        d = sqn[:, None] + csqn[None, :] - 2.0 * d
+        return jnp.minimum(min_dist, jnp.min(d, axis=1))
+
+    def _chunk_body(factors, sqn, cfactors, min_dist):
+        # Initial-min fold for one labeled chunk whose factor rows ride
+        # in replicated (host-sliced — the caller owns the host copy of
+        # the factors; this never materializes DEVICE state on host).
+        csqn = None
+        for cf in cfactors:
+            s = jnp.sum(cf * cf, axis=1)
+            csqn = s if csqn is None else csqn * s
+        return _strip_min(factors, sqn, cfactors, csqn, min_dist)
+
+    def _minimax_block_body(factors, sqn, row_max, cfactors):
+        csqn = None
+        for cf in cfactors:
+            s = jnp.sum(cf * cf, axis=1)
+            csqn = s if csqn is None else csqn * s
+        d = None
+        for f, cf in zip(factors, cfactors):
+            dd = f @ cf.T
+            d = dd if d is None else d * dd
+        d = sqn[:, None] + csqn[None, :] - 2.0 * d
+        return jnp.maximum(row_max, jnp.max(d, axis=1))
+
+    def _argmin_body(row_max, valid):
+        # Pad rows (valid 0) forced to +inf so they can never win the
+        # minimax seed's argmin; ties to the lowest global index.
+        rm = jnp.where(valid > 0, row_max, jnp.inf)
+        m_loc = jnp.min(rm)
+        m = jax.lax.pmin(m_loc, axis)
+        n_total = ndev * rm.shape[0]
+        cand = jnp.where(m_loc <= m,
+                         jnp.argmin(rm).astype(jnp.int32)
+                         + _offset(rm.shape[0]),
+                         jnp.int32(n_total))
+        return jax.lax.pmin(cand, axis)
+
+    def _scan_body(factors, sqn, min_dist, selectable, key, budget: int,
+                   randomize: bool):
+        n_total = sqn.shape[0] * ndev
+
+        def step(carry, key):
+            min_dist, selectable = carry
+            if randomize:
+                # The D^2 draw needs the full weight vector; all_gather
+                # the O(N) scores (NOT the [N, D] factors) so the
+                # categorical consumes the exact global vector the
+                # replicated scan does — same bits, same draw.
+                p = jnp.clip(min_dist, 0.0, None) * selectable
+                p_all = jax.lax.all_gather(p, axis, tiled=True)
+                sel_all = jax.lax.all_gather(selectable, axis, tiled=True)
+                total = jnp.sum(p_all)
+                weights = jnp.where(total > 0, p_all, sel_all)
+                idx = jax.random.categorical(
+                    key, jnp.log(weights)).astype(jnp.int32)
+            else:
+                masked = jnp.where(selectable > 0, min_dist, -jnp.inf)
+                idx = _argmax_global(masked, n_total)
+            crows, csqn = _take(factors, sqn, idx[None])
+            d = None
+            for f, r in zip(factors, crows):
+                dd = f @ r[0]  # matvec, like the replicated dots_to
+                d = dd if d is None else d * dd
+            min_dist = jnp.minimum(min_dist, sqn + csqn[0] - 2.0 * d)
+            selectable = selectable.at[_owned_or_oob(idx, sqn.shape[0])
+                                       ].set(0.0, mode="drop")
+            return (min_dist, selectable), idx
+
+        keys = jax.random.split(key, budget)
+        _, picks = jax.lax.scan(step, (min_dist, selectable), keys)
+        return picks
+
+    def _scan_batched_body(factors, sqn, min_dist, selectable, budget: int,
+                           q: int):
+        n_total = sqn.shape[0] * ndev
+        picks0 = jnp.zeros(budget + q, jnp.int32)
+
+        def cond(st):
+            return st[3] < budget
+
+        def body(st):
+            min_dist, selectable, picks, count = st
+            masked = jnp.where(selectable > 0, min_dist, -jnp.inf)
+            vals, cands = _topk_global(masked, q)
+            crows, csqn = _take(factors, sqn, cands)
+            d_cc = None
+            for r in crows:
+                dd = r @ r.T
+                d_cc = dd if d_cc is None else d_cc * dd
+            d_cc = csqn[:, None] + csqn[None, :] - 2.0 * d_cc
+            order, n_acc = _recheck_candidates(
+                cands, vals, d_cc, jnp.minimum(q, budget - count), n_total)
+            slot = jnp.arange(q)
+            seq = jnp.where(slot < n_acc, cands[order], cands[order[0]])
+            srows, ssqn = _take(factors, sqn, seq)
+            min_dist = _strip_min(factors, sqn, srows, ssqn, min_dist)
+            selectable = selectable.at[_owned_or_oob(seq, sqn.shape[0])
+                                       ].set(0.0, mode="drop")
+            picks = jax.lax.dynamic_update_slice(picks, seq, (count,))
+            return (min_dist, selectable, picks, count + n_acc)
+
+        _, _, picks, _ = jax.lax.while_loop(
+            cond, body, (min_dist, selectable, picks0, jnp.int32(0)))
+        return picks[:budget]
+
+    # No donate_argnums on the sharded jits: the would-be-donated
+    # carries are the O(N) min-dist/selectable vectors (KBs-to-MBs,
+    # never the factor matrix), and XLA:CPU rejects donation of sharded
+    # buffers with a per-call warning — not worth the log spam.
+    @functools.partial(jax.jit, static_argnames=("budget", "q"))
+    def scan_batched(factors, sqn, min_dist, selectable, budget, q):
+        return shard_map(
+            lambda f, s, md, sel: _scan_batched_body(f, s, md, sel,
+                                                     budget, q),
+            mesh=mesh, in_specs=(fspec, vec, vec, vec), out_specs=rep,
+            check_rep=False)(factors, sqn, min_dist, selectable)
+
+    @functools.partial(jax.jit, static_argnames=("budget", "randomize"))
+    def scan_q1(factors, sqn, min_dist, selectable, key, budget, randomize):
+        return shard_map(
+            lambda f, s, md, sel, k: _scan_body(f, s, md, sel, k, budget,
+                                                randomize),
+            mesh=mesh, in_specs=(fspec, vec, vec, vec, rep),
+            out_specs=rep, check_rep=False)(factors, sqn, min_dist,
+                                            selectable, key)
+
+    @jax.jit
+    def min_chunk(factors, sqn, cfactors, min_dist):
+        return shard_map(
+            _chunk_body, mesh=mesh, in_specs=(fspec, vec, repf, vec),
+            out_specs=vec, check_rep=False)(factors, sqn, cfactors,
+                                            min_dist)
+
+    @jax.jit
+    def minimax_block(factors, sqn, row_max, cfactors):
+        return shard_map(
+            _minimax_block_body, mesh=mesh,
+            in_specs=(fspec, vec, vec, repf), out_specs=vec,
+            check_rep=False)(factors, sqn, row_max, cfactors)
+
+    @jax.jit
+    def argmin_valid(row_max, valid):
+        return shard_map(_argmin_body, mesh=mesh, in_specs=(vec, vec),
+                         out_specs=rep, check_rep=False)(row_max, valid)
+
+    return {"scan_batched": scan_batched, "scan_q1": scan_q1,
+            "min_chunk": min_chunk, "minimax_block": minimax_block,
+            "argmin_valid": argmin_valid}
+
+
+def _sharded_jits(mesh, nf: int) -> Dict:
+    key = (mesh, nf)
+    if key not in _SHARDED_JITS:
+        _SHARDED_JITS[key] = _build_sharded_fns(mesh, nf)
+    return _SHARDED_JITS[key]
+
+
+def _kcenter_greedy_sharded(factors_np: Tuple[np.ndarray, ...],
+                            labeled_mask: np.ndarray, budget: int,
+                            randomize: bool, rng, q: int, key,
+                            mesh) -> np.ndarray:
+    """Row-sharded greedy k-center: the same selection as the replicated
+    scans (bit-identical picks — see _build_sharded_fns), with per-chip
+    residency of rows/ndev.  The factors arrive as HOST arrays and are
+    uploaded per shard straight into the row sharding
+    (mesh_lib.shard_rows) — the full matrix never materializes on any
+    one device nor a second (padded) time on host; the host copy also
+    feeds the initial min pass and the minimax seed their replicated
+    [chunk, D] column blocks (index math + slicing only, no device
+    round-trips)."""
+    n = labeled_mask.shape[0]
+    n_pad = bucket_size(n, floor=POOL_BUCKET_FLOOR)
+    ndev = mesh.devices.size
+    fns = _sharded_jits(mesh, len(factors_np))
+    vec_sh = jax.sharding.NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+
+    # Per-shard upload straight into the row sharding (shard_rows with
+    # rows=n_pad): the bucket pad materializes only on the tail shard's
+    # block, so the matrix never holds a second, padded host copy — at
+    # the 10.5 GB full-ImageNet scale that transient double would OOM
+    # the very hosts the sharded pool targets.
+    factors = tuple(mesh_lib.shard_rows(f, mesh, rows=n_pad)
+                    for f in factors_np)
+    # Row-wise self-norms: elementwise + a D-axis reduction, so the
+    # eager dispatch stays row-sharded with no collectives, and each
+    # row's bits match the replicated self_sq_norms.
+    sqn = self_sq_norms(factors)
+
+    labeled_idxs = np.flatnonzero(labeled_mask)
+    picks_pre: list = []
+    if len(labeled_idxs) == 0:
+        if randomize:
+            seed_idx = int(rng.integers(n))
+        else:
+            # Sharded minimax seed: fold host-sliced column blocks (the
+            # SAME wraparound block layout as _minimax_row) into a
+            # sharded row_max, then a global argmin with pad rows
+            # masked to +inf.  max/min folds are exact, so the seed is
+            # the replicated seed.
+            block = 2048
+            order = np.arange(n + ((-n) % block)) % n
+            valid = np.zeros(n_pad, np.float32)
+            valid[:n] = 1.0
+            row_max = jax.device_put(
+                np.full(n_pad, -np.inf, np.float32), vec_sh)
+            for cols in order.reshape(-1, block):
+                cf = tuple(f[cols] for f in factors_np)
+                row_max = fns["minimax_block"](factors, sqn, row_max, cf)
+            seed_idx = int(fns["argmin_valid"](
+                row_max, jax.device_put(valid, vec_sh)))
+        picks_pre.append(seed_idx)
+        labeled_idxs = np.asarray([seed_idx])
+        budget -= 1
+    if budget <= 0:
+        return np.asarray(picks_pre, dtype=np.int64)
+    q = max(1, min(q, budget))
+
+    # Initial min pass: labeled chunks ride in as replicated host-sliced
+    # factor rows (fixed [1024, D] shape — reused across rounds), the
+    # [rows/ndev, 1024] strip and min fold run shard-local.
+    chunk_size = 1024
+    min_dist = jax.device_put(np.full(n_pad, np.inf, np.float32), vec_sh)
+    for start in range(0, len(labeled_idxs), chunk_size):
+        chunk = labeled_idxs[start:start + chunk_size]
+        if len(chunk) < chunk_size:  # pad with repeats: min is unaffected
+            chunk = np.concatenate(
+                [chunk, np.repeat(chunk[:1], chunk_size - len(chunk))])
+        cf = tuple(f[chunk] for f in factors_np)
+        min_dist = fns["min_chunk"](factors, sqn, cf, min_dist)
+
+    selectable = np.zeros(n_pad, dtype=np.float32)
+    selectable[:n] = 1.0
+    selectable[labeled_idxs] = 0.0
+    sel_dev = jax.device_put(selectable, vec_sh)
+
+    global LAST_BACKEND
+    if q > 1:
+        picks = np.asarray(fns["scan_batched"](factors, sqn, min_dist,
+                                               sel_dev, budget, q),
+                           dtype=np.int64)
+        LAST_BACKEND = "xla-batched"
+    else:
+        picks = np.asarray(fns["scan_q1"](factors, sqn, min_dist, sel_dev,
+                                          key, budget, bool(randomize)),
+                           dtype=np.int64)
+        LAST_BACKEND = "xla"
+    return np.concatenate([np.asarray(picks_pre, dtype=np.int64), picks])
+
+
+def row_capable(n: int, budget: int, mesh, batch_q: Optional[int] = None,
+                randomize: bool = False) -> bool:
+    """Whether ``kcenter_greedy`` would resolve a non-"replicated"
+    ``pool_sharding`` to the row-sharded backend for this geometry:
+    a single-process mesh with >1 device, the bucketed pool size
+    dividing evenly over it, and at least one candidate batch of rows
+    per shard.  This IS the gate ``kcenter_greedy`` applies — callers
+    that must know the layout BEFORE paying for a selection (the
+    ``kcenter_select_maxn`` bench climbs an ndev-times-larger pool on
+    the row rungs) pre-check here instead of discovering a silent
+    replicated fallback, at ndev times the per-chip bytes, after the
+    run."""
+    if mesh is None:
+        return False
+    ndev = mesh.devices.size
+    budget = max(1, int(budget))
+    q = 1 if randomize else int(batch_q or DEFAULT_BATCH_Q)
+    q = max(1, min(q, budget))
+    n_pad = bucket_size(n, floor=POOL_BUCKET_FLOOR)
+    return (ndev > 1 and not mesh_lib.is_multiprocess(mesh)
+            and n_pad % ndev == 0 and n_pad // ndev >= q)
+
+
 def kcenter_greedy(
     factors: Sequence[np.ndarray],
     labeled_mask: np.ndarray,
@@ -308,18 +693,23 @@ def kcenter_greedy(
     rng: Optional[np.random.Generator] = None,
     batch_q: Optional[int] = None,
     mesh=None,
+    pool_sharding: Optional[str] = None,
 ) -> np.ndarray:
     """Select ``budget`` local row indices by greedy k-center over the
     factorized embeddings.  Matches coreset_sampler.coreset(:66-105):
     deterministic mode takes the farthest-point argmax (batched q picks
     per pool pass, pick-for-pick identical — see module docstring);
     randomized mode draws with D^2 probabilities one pick at a time.
-    ``mesh``: optional single-process device mesh; when given, the pool
-    axis is sharded over its data axis so the per-step distance pass and
-    strip-min run shard-local (one cross-shard reduction per step).
-    Returns selections in pick order."""
-    factors = tuple(jnp.asarray(np.asarray(f), dtype=jnp.float32)
-                    for f in factors)
+
+    ``mesh`` + ``pool_sharding``: with a single-process multi-device
+    mesh and pool_sharding "row" (or None/"auto"), the pool axis is
+    ROW-SHARDED over the mesh's data axis and selection runs on the
+    collective backend (_build_sharded_fns): distance strips and min
+    folds shard-local, one argmax/top-q collective per step, center
+    rows gathered from their owners — pick-for-pick identical to the
+    replicated scans while each chip holds only rows/ndev of the factor
+    matrix.  "replicated" forces the single-chip layout.  Returns
+    selections in pick order."""
     labeled_mask = np.asarray(labeled_mask, dtype=bool)
     n = labeled_mask.shape[0]
     budget = int(budget)
@@ -328,7 +718,23 @@ def kcenter_greedy(
     if rng is None:
         rng = np.random.default_rng()
     key = jax.random.PRNGKey(int(rng.integers(2 ** 31)))
+    q = 1 if randomize else int(batch_q or DEFAULT_BATCH_Q)
+    q = max(1, min(q, budget))
 
+    global LAST_SHARDING
+    use_row = (pool_sharding != "replicated"
+               and row_capable(n, budget, mesh, batch_q=batch_q,
+                               randomize=randomize))
+    if use_row:
+        LAST_SHARDING = "row"
+        factors_np = tuple(np.asarray(f, dtype=np.float32)
+                           for f in factors)
+        return _kcenter_greedy_sharded(factors_np, labeled_mask, budget,
+                                       randomize, rng, q, key, mesh)
+    LAST_SHARDING = "replicated"
+
+    factors = tuple(jnp.asarray(np.asarray(f), dtype=jnp.float32)
+                    for f in factors)
     sqn = self_sq_norms(factors)
     labeled_idxs = np.flatnonzero(labeled_mask)
     picks_pre: list = []
@@ -346,7 +752,6 @@ def kcenter_greedy(
     if budget <= 0:
         return np.asarray(picks_pre, dtype=np.int64)
 
-    q = 1 if randomize else int(batch_q or DEFAULT_BATCH_Q)
     q = max(1, min(q, budget))
 
     # Power-of-two pool bucketing: subset-capped pools drift in size
@@ -368,21 +773,7 @@ def kcenter_greedy(
     selectable[labeled_idxs] = 0.0
 
     global LAST_BACKEND
-    if (mesh is not None and mesh.devices.size > 1
-            and not mesh_lib.is_multiprocess(mesh)
-            and n_pad % mesh.devices.size == 0):
-        # Shard the pool axis over the mesh: the per-step [N, q]
-        # distance pass, strip min, and running-min update all run
-        # shard-local; the top-k / argmax is the step's one
-        # cross-shard reduction.  Exact — min/max reductions do no
-        # rounding and each row's matvec stays on one shard.
-        sh = mesh_lib.batch_sharding(mesh)
-        factors = tuple(jax.device_put(f, sh) for f in factors)
-        sqn = jax.device_put(sqn, sh)
-        min_dist = jax.device_put(min_dist, sh)
-        sel_dev = jax.device_put(jnp.asarray(selectable), sh)
-    else:
-        sel_dev = jnp.asarray(selectable)
+    sel_dev = jnp.asarray(selectable)
     if q > 1:
         picks = np.asarray(
             _kcenter_scan_batched(factors, sqn, min_dist, sel_dev,

@@ -306,6 +306,7 @@ def collect_pool(
     resident_cache: Optional[Dict] = None,
     resident_max_bytes: int = RESIDENT_MAX_BYTES,
     host_s2d: bool = False,
+    pool_sharding: str = "replicated",
 ) -> Dict[str, np.ndarray]:
     """Run ``step_fn`` over ``dataset[idxs]`` in fixed-shape sharded batches
     and return host arrays of length ``len(idxs)``, row i scoring pool index
@@ -357,12 +358,22 @@ def collect_pool(
     # bytes cross the host<->device boundary after the first round.  A
     # pool that is ALREADY uploaded keeps its fast path even if a budget
     # refresh shrank the budget below its size (resident_lib.cached).
+    # ``pool_sharding`` "row": the upload is row-sharded (rows/ndev per
+    # chip) and the runner assembles each batch from the shard owners —
+    # scores stay bit-identical (tests/test_pool_sharding.py); the
+    # runner follows the ENTRY's actual layout either way.
+    shard_ways = (mesh.devices.size
+                  if pool_sharding == "row" and mesh is not None else 1)
     if (resident_cache is not None
             and resident_lib.eligible(dataset, resident_max_bytes,
-                                      cache=resident_cache)):
+                                      cache=resident_cache,
+                                      shard_ways=shard_ways)):
         images_dev, _ = resident_lib.pool_arrays(resident_cache, dataset,
-                                                 mesh)
-        run = resident_lib.get_runner(resident_cache, step_fn, mesh)
+                                                 mesh,
+                                                 sharding=pool_sharding)
+        run = resident_lib.get_runner(
+            resident_cache, step_fn, mesh,
+            sharded=mesh_lib.is_row_sharded(images_dev))
         multi = mesh_lib.is_multiprocess(mesh)
         chunks: Dict[str, list] = {}
         t_chunk, chunk_first = t_pool0, 0

@@ -133,6 +133,16 @@ class Trainer:
         from ..parallel import resident as resident_lib
         self.resident_budget = resident_lib.resolve_budget(
             train_cfg.resident_scoring_bytes)
+        # Resident-pool LAYOUT, resolved ONCE for the experiment
+        # (DESIGN.md §2b): "row" shards pool rows over the mesh's data
+        # axis (per-chip residency = rows/ndev), "replicated" pins one
+        # copy per chip.  _shard_ways feeds the eligibility math: under
+        # row sharding a chip pins ceil(rows/ndev) rows, so the budget
+        # admits pools ~ndev times larger.
+        self.pool_sharding = resident_lib.resolve_sharding(
+            getattr(train_cfg, "pool_sharding", "auto"), mesh)
+        self._shard_ways = (self.n_devices
+                            if self.pool_sharding == "row" else 1)
         # The feed the LAST fit actually used + its host-stall figures —
         # round-boundary telemetry (driver gauges) and bench attribution
         # read it; {"source": None} until a fit has run.
@@ -304,22 +314,30 @@ class Trainer:
         XLA:CPU executes large conv bodies INSIDE ``lax.scan`` several
         times slower than the same ops dispatched directly (measured 6x
         on ResNet-18 at 112px), while on accelerators the scan's
-        one-dispatch-per-epoch wins.  Compiles once per experiment (the
-        pool shape is constant and the index vector is [batch]-sized —
-        no step bucketing involved)."""
+        one-dispatch-per-epoch wins.  Compiles once per experiment AND
+        POOL LAYOUT (the pool shape is constant and the index vector is
+        [batch]-sized — no step bucketing involved; ``sharded`` is
+        static and fixed per experiment, so warm rounds still add zero
+        compiles).  With a row-sharded pool the gather goes through
+        resident.sharded_pool_gather (owner psum into the batch
+        sharding) instead of a full-array index — same bytes, same
+        batch sharding, bit-identical training."""
         train_step = self._train_step
         mesh = self.mesh
+        from ..parallel import resident as resident_lib
 
-        @functools.partial(jax.jit, static_argnames=("view",),
+        @functools.partial(jax.jit, static_argnames=("view", "sharded"),
                            donate_argnums=(0, 5))
         def resident_batch_step(state, images, labels, ids, mask, key,
-                                lr, class_weights, view):
-            batch = {
-                "image": jax.lax.with_sharding_constraint(
-                    images[ids], mesh_lib.batch_sharding(mesh)),
-                "label": labels[ids],
-                "mask": mask,
-            }
+                                lr, class_weights, view, sharded=False):
+            if sharded:
+                img, lab = resident_lib.sharded_pool_gather(
+                    images, ids, mesh, labels=labels)
+            else:
+                img = jax.lax.with_sharding_constraint(
+                    images[ids], mesh_lib.batch_sharding(mesh))
+                lab = labels[ids]
+            batch = {"image": img, "label": lab, "mask": mask}
             new_key, sub = jax.random.split(key)
             new_state, loss, gnorm = train_step(state, batch, sub, lr,
                                                 class_weights, view=view)
@@ -342,23 +360,31 @@ class Trainer:
         """
         train_step = self._train_step
         mesh = self.mesh
+        from ..parallel import resident as resident_lib
 
-        @functools.partial(jax.jit, static_argnames=("view",),
+        @functools.partial(jax.jit, static_argnames=("view", "sharded"),
                            donate_argnums=(0,))
         def epoch_scan(state, images, labels, idx_mat, mask_mat, valid,
-                       key, lr, class_weights, view):
+                       key, lr, class_weights, view, sharded=False):
             batch_sharding = mesh_lib.batch_sharding(mesh)
 
             def body(carry, inp):
                 state, key = carry
                 idxs, mask, v = inp
                 new_key, sub = jax.random.split(key)
-                batch = {
-                    "image": jax.lax.with_sharding_constraint(
-                        images[idxs], batch_sharding),
-                    "label": labels[idxs],
-                    "mask": mask,
-                }
+                if sharded:
+                    # Row-sharded pool: batch rows assembled from their
+                    # owning shards (resident.sharded_pool_gather) into
+                    # the SAME batch sharding the constraint below
+                    # commits — bit-identical batches, shard_map
+                    # composes inside the scan body.
+                    img, lab = resident_lib.sharded_pool_gather(
+                        images, idxs, mesh, labels=labels)
+                else:
+                    img = jax.lax.with_sharding_constraint(
+                        images[idxs], batch_sharding)
+                    lab = labels[idxs]
+                batch = {"image": img, "label": lab, "mask": mask}
                 new_state, loss, gnorm = train_step(state, batch, sub, lr,
                                                     class_weights, view=view)
                 # Bucket-padding steps (v == 0) are fully selected away —
@@ -444,7 +470,8 @@ class Trainer:
         scan_possible = hook_free and in_mem \
             and self.cfg.device_resident is not False
         resident_ok = scan_possible and resident_lib.eligible(
-            train_set, self.resident_budget, cache=self.resident_pool)
+            train_set, self.resident_budget, cache=self.resident_pool,
+            shard_ways=self._shard_ways)
         if mode == "resident":
             if resident_ok:
                 return "resident"
@@ -499,12 +526,15 @@ class Trainer:
         """The resident-gather feed's arrays: the SAME pinned (pool,
         labels) pair scoring and evaluation use — one upload for the
         whole experiment, no second HBM copy, and NOTHING host-side
-        beyond the shared-cache lookup.  The zero-host-copy invariant is
-        enforced statically: scripts/trace_lint.py forbids any np.* or
-        .gather() materialization inside this function."""
+        beyond the shared-cache lookup.  Uploaded in the experiment's
+        resolved pool layout (row-sharded = rows/ndev per chip).  The
+        zero-host-copy invariant is enforced statically:
+        scripts/trace_lint.py forbids any np.* or .gather()
+        materialization inside this function."""
         from ..parallel import resident as resident_lib
         return resident_lib.pool_arrays(self.resident_pool, train_set,
-                                        self.mesh)
+                                        self.mesh,
+                                        sharding=self.pool_sharding)
 
     def _device_resident_arrays(self, train_set: Dataset,
                                 labeled_idxs: np.ndarray, batch_size: int):
@@ -649,16 +679,21 @@ class Trainer:
 
         from ..parallel import resident as resident_lib
         if resident_lib.eligible(dataset, self.resident_budget,
-                                 cache=self.resident_pool):
+                                 cache=self.resident_pool,
+                                 shard_ways=self._shard_ways):
             # Device-resident path: on-device row gather per batch, count
             # totals accumulated ON DEVICE (one host fetch at the end) so
             # async dispatch pipelines the whole eval pass; see
             # parallel/resident.py for the shared cache and the
             # virtual-CPU-mesh caveat.  resident_scoring_bytes=0 disables.
+            # The runner follows the ENTRY's actual layout (an entry
+            # uploaded row-sharded stays row-sharded for every consumer).
             images_dev, labels_dev = resident_lib.pool_arrays(
-                self.resident_pool, dataset, self.mesh)
-            run = resident_lib.get_runner(self.resident_pool, eval_step,
-                                          self.mesh, with_labels=True)
+                self.resident_pool, dataset, self.mesh,
+                sharding=self.pool_sharding)
+            run = resident_lib.get_runner(
+                self.resident_pool, eval_step, self.mesh, with_labels=True,
+                sharded=mesh_lib.is_row_sharded(images_dev))
             totals = None
             for b in batch_index_lists(np.asarray(idxs), bs):
                 ids, mask = padded_batch_layout(b, bs)
@@ -754,12 +789,20 @@ class Trainer:
                                    "step" if feed == "resident" else
                                    "loop")}
         feed_map = None
+        dr_sharded = False
         if feed == "resident":
             # Local epoch-matrix positions -> GLOBAL pool rows.  int32:
             # resident pools are bounded by HBM, far under 2^31 rows.
             feed_map = np.asarray(labeled_idxs, dtype=np.int32)
             dr_images, dr_labels = self._resident_feed_arrays(train_set)
+            # Execution follows the entry's ACTUAL layout (a pool pinned
+            # replicated before a config change stays replicated): the
+            # flag is static on the jitted forms, fixed per experiment.
+            dr_sharded = mesh_lib.is_row_sharded(dr_images)
         elif feed == "resident_copy":
+            # The legacy private labeled-subset copy stays replicated
+            # (it is bucket-padded per round; sharding it would buy
+            # little and cost a layout axis on the step bucketing).
             dr_images, dr_labels = self._device_resident_arrays(
                 train_set, labeled_idxs, bs)
         if use_scan and self._epoch_scan is None:
@@ -889,7 +932,8 @@ class Trainer:
                 state, key, losses, gnorms = self._epoch_scan(
                     state, dr_images, dr_labels, jnp.asarray(idx_mat),
                     jnp.asarray(mask_mat), jnp.asarray(valid), key, lr,
-                    class_weights, view=train_set.view)
+                    class_weights, view=train_set.view,
+                    sharded=dr_sharded)
                 epoch_loss = jnp.sum(losses) / steps_real
                 epoch_gnorm = jnp.sum(gnorms) / steps_real
                 steps_run = steps_real
@@ -908,7 +952,8 @@ class Trainer:
                         (ids.astype(np.int32), mask), self.mesh)
                     state, key, loss, gnorm = self._resident_batch_step(
                         state, dr_images, dr_labels, *small, key, lr,
-                        class_weights, view=train_set.view)
+                        class_weights, view=train_set.view,
+                        sharded=dr_sharded)
                     losses.append(loss)
                     gnorms.append(gnorm)
                     if collect:

@@ -189,12 +189,14 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # Hard bound on the ONE stdout line: the consuming harness records a
 # ~2,000-byte tail of stdout — which carries nothing but this line — so
 # the bound needs enough margin for tail-window slop, not another whole
-# line.  1600 leaves 400 bytes of margin and fits the 13-phase
+# line.  1680 leaves 320 bytes of margin and fits the 13-phase
 # realistic-maximal rich form (every phase cached with every optional
-# rider, including the feed-hierarchy fields) without truncation;
-# staged truncation in _compact_line still guards the pathological
-# cases.  Pinned by unit tests at both extremes.
-MAX_LINE_BYTES = 1600
+# rider: the feed-hierarchy fields, and now unit/backend on BOTH
+# paper-scale selection phases plus the sharded-ceiling probe's
+# pool_sharding tag — ISSUE 6 grew the honest maximum by ~70 bytes)
+# without truncation; staged truncation in _compact_line still guards
+# the pathological cases.  Pinned by unit tests at both extremes.
+MAX_LINE_BYTES = 1680
 
 
 def log(msg: str) -> None:
@@ -448,17 +450,15 @@ def _datapath_model_passes(result, dataset, cached_set, batch_size,
     # with every decode thread busy; the WARM pass is the steady-state
     # rounds-1+ memmap feed, whose rate is bounded by page-cache/gather
     # bandwidth, not decode parallelism — on a many-core host cold decode
-    # can legitimately out-rate the single-stream warm gather.  Canonical
-    # names say which is which; the bare ips/ips_warm keys are kept for
-    # ONE release (deprecated, see "deprecated_keys").
+    # can legitimately out-rate the single-stream warm gather.  The
+    # canonical names (cold_populate_ips / warm_memmap_ips) are the ONLY
+    # spellings; the deprecated ips_warm alias and its deprecated_keys
+    # shim served their one release (PR 5) and are gone.  ``ips`` stays
+    # as the generic phase-schema throughput key every phase carries.
     result.update(
         ips=round(ips, 1), ips_per_chip=round(ips / n_chips, 1),
         cold_populate_ips=round(ips, 1),
-        score_sec=round(score_sec, 1),
-        deprecated_keys={"ips": "renamed cold_populate_ips "
-                                "(decode-once populate pass)",
-                         "ips_warm": "renamed warm_memmap_ips "
-                                     "(steady-state memmap feed)"})
+        score_sec=round(score_sec, 1))
     yield dict(result)  # cold pass is safe with the parent
     if cached_set is not dataset:
         # Steady state: rounds 1+ re-score the pool from the warm cache.
@@ -468,14 +468,14 @@ def _datapath_model_passes(result, dataset, cached_set, batch_size,
                                    prefetch=4, keys=("margin",))
         warm_sec = time.perf_counter() - t0
         assert len(out["margin"]) == len(dataset)
-        result.update(ips_warm=round(len(dataset) / warm_sec, 1),
-                      warm_memmap_ips=round(len(dataset) / warm_sec, 1),
+        result.update(warm_memmap_ips=round(len(dataset) / warm_sec, 1),
                       warm_score_sec=round(warm_sec, 1))
         yield dict(result)  # warm pass is safe with the parent
         # Host-side-only warm rate (cache gather + batch assembly, no
-        # device work): decomposes ips_warm into host vs device+h2d the
-        # way decode_ips does for the cold pass — on a 1-core sandbox the
-        # warm pass is HOST-bound and this number says by how much.
+        # device work): decomposes warm_memmap_ips into host vs
+        # device+h2d the way decode_ips does for the cold pass — on a
+        # 1-core sandbox the warm pass is HOST-bound and this number
+        # says by how much.
         t0 = time.perf_counter()
         rows = 0
         for start in range(0, len(dataset), batch_size):
@@ -535,7 +535,7 @@ def _datapath_model_passes(result, dataset, cached_set, batch_size,
                     f"{len(out['margin'])} rows for {len(dataset)}")
             else:
                 result.update(
-                    ips_warm_resident=round(len(dataset) / resident_sec,
+                    warm_resident_ips=round(len(dataset) / resident_sec,
                                             1),
                     warm_resident_sec=round(resident_sec, 1),
                     resident_upload_sec=round(upload_sec, 1))
@@ -759,6 +759,7 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
         "budget": budget,
         "batch_q": DEFAULT_BATCH_Q,
         "backend": kc.LAST_BACKEND,
+        "pool_sharding": kc.LAST_SHARDING,
         "select_sec": round(dt, 2),
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
@@ -775,38 +776,96 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
 
 def run_kcenter_maxn_phase(budget: int, dim: int = 2048):
     """Climb + bisect toward the largest pool the no-partition k-center
-    scan completes: 160k -> 320k -> 640k -> 1.28M rows of [N, 2048] f32
-    factors (1.28M x 2048 x 4 = 10.5 GB — the FULL ImageNet pool), with a
-    couple of bisection steps between the last success and the first
-    failure.  Each attempt records picks/s and peak HBM, so DESIGN.md
-    §3's analytic no-partition envelope gets a measured boundary; the
-    failure mode past the envelope (RESOURCE_EXHAUSTED) is recorded, not
-    fatal.  GENERATOR: yields after every completed attempt so a timeout
-    loses only the unfinished pool size.  CPU backends climb a tiny
-    ladder instead — the envelope question is an HBM question."""
+    scan completes — now under BOTH resident layouts (ISSUE 6):
+
+      1. REPLICATED: the single-chip ceiling, 160k -> 320k -> 640k ->
+         1.28M rows of [N, 2048] f32 factors (1.28M x 2048 x 4 =
+         10.5 GB — the FULL ImageNet pool), with a couple of bisection
+         steps between the last success and the first failure.  This is
+         the pre-sharding envelope (``replicated_max_n`` /
+         ``no_partition_holds_to_n``).
+      2. ROW-SHARDED (multi-device meshes): the same climb with the
+         ladder scaled by the device count — each chip holds rows/ndev
+         of the factor matrix (strategies/kcenter._build_sharded_fns),
+         so max-N should scale ~linearly with chips.  The phase ASSERTS
+         ``max_n >= 2 * replicated_max_n`` whenever both layouts
+         completed a climb on a >=2-device mesh at equal per-chip HBM
+         (``row_scale_x`` records the measured ratio) — the acceptance
+         gate for breaking, not just finding, the ceiling.
+
+    Each attempt records picks/s, its analytic per-chip factor bytes
+    (``factor_gb_per_chip`` — the equal-per-chip-HBM evidence), and the
+    measured per-chip / mesh-total peak HBM; ``peak_bytes_in_use`` is a
+    process-lifetime high-water mark, so an attempt that peaked below an
+    earlier one carries ``peak_hbm_carryover`` instead of claiming the
+    stale figure as its own.  Row rungs whose bucketed pool cannot split
+    over the mesh (``kcenter.row_capable``) are refused before any
+    compute — the greedy would silently run them replicated at ndev
+    times the per-chip bytes.  Failures past the envelope
+    (RESOURCE_EXHAUSTED) are recorded, not fatal.  GENERATOR: yields
+    after every completed attempt so a timeout loses only the unfinished
+    pool size.  CPU backends climb a tiny ladder instead — the envelope
+    question is an HBM question; the layout-scaling question still
+    answers structurally."""
     import numpy as np
 
     import jax
-    from active_learning_tpu.strategies.kcenter import kcenter_greedy
+    from active_learning_tpu.parallel import mesh as mesh_lib
+    from active_learning_tpu.strategies import kcenter as kc
 
     platform = jax.devices()[0].platform
     device_kind = jax.devices()[0].device_kind
+    n_chips = len(jax.devices())
+    mesh = mesh_lib.make_mesh() if n_chips > 1 else None
+    sharding = "row" if mesh is not None else "replicated"
     if platform == "cpu":
         ladder = [4096, 8192, 16384]
         budget = min(budget, 64)
     else:
         ladder = [160_000, 320_000, 640_000, 1_280_000]
+    row_ladder = [n * n_chips for n in ladder]
     result = {
         "phase": "kcenter_select_maxn",
         "ips": None, "ips_per_chip": None, "unit": "picks/sec",
-        "n_chips": 1, "dim": dim, "budget": budget, "max_n": 0,
-        "target_n": ladder[-1], "attempts": [],
+        "n_chips": n_chips, "dim": dim, "budget": budget,
+        "pool_sharding": sharding, "max_n": 0, "replicated_max_n": 0,
+        "target_n": (row_ladder if mesh is not None else ladder)[-1],
+        "attempts": [],
         "device_kind": device_kind, "platform": platform,
     }
 
-    def attempt(n: int):
+    def hbm_peaks():
+        per = []
+        try:
+            for d in jax.local_devices():
+                stats = d.memory_stats() or {}
+                p = stats.get("peak_bytes_in_use")
+                if p:
+                    per.append(int(p))
+        except Exception:
+            pass  # memory_stats is backend-dependent; absence is fine
+        if not per:
+            return None, None
+        return max(per), sum(per)
+
+    def attempt(n: int, use_mesh):
+        layout = "row" if use_mesh is not None else "replicated"
+        if use_mesh is not None and not kc.row_capable(n, budget,
+                                                       use_mesh):
+            # The greedy's own gate would silently fall back to the
+            # replicated backend (e.g. a bucketed pool that doesn't
+            # divide over a non-power-of-two mesh) — which on a row
+            # rung means ndev times the intended per-chip bytes and a
+            # wrong-layout timing.  Refuse BEFORE any compute so the
+            # climb records a layout-capability skip, never a
+            # misattributed OOM.
+            raise RuntimeError(
+                f"row layout unavailable for n={n}: the bucketed pool "
+                f"does not split over {use_mesh.devices.size} devices "
+                "(kcenter.row_capable) — skipped before any compute")
         log(f"[kcenter_select_maxn] trying pool [{n}, {dim}] "
-            f"({n * dim * 4 / 2**30:.1f} GB of factors)")
+            f"({n * dim * 4 / 2**30:.1f} GB of factors, {layout})")
+        pre_peak, _ = hbm_peaks()
         rng = np.random.default_rng(0)
         # Chunked generation: a 1.28M-row normal draw in one call holds
         # two 10.5 GB temporaries on the host.
@@ -817,65 +876,130 @@ def run_kcenter_maxn_phase(budget: int, dim: int = 2048):
                 (hi - lo, dim), dtype=np.float32)
         labeled = np.zeros(n, dtype=bool)
         labeled[rng.choice(n, min(1000, n // 8), replace=False)] = True
+        kcenter_greedy = kc.kcenter_greedy
         kcenter_greedy((emb,), labeled, budget,
-                       rng=np.random.default_rng(1))  # compile
+                       rng=np.random.default_rng(1), mesh=use_mesh,
+                       pool_sharding=layout)  # compile
         t0 = time.perf_counter()
         picks = kcenter_greedy((emb,), labeled, budget,
-                               rng=np.random.default_rng(2))
+                               rng=np.random.default_rng(2),
+                               mesh=use_mesh, pool_sharding=layout)
         dt = time.perf_counter() - t0
         assert len(set(picks.tolist())) == budget
+        assert kc.LAST_SHARDING == layout, (
+            f"requested {layout} but selection ran {kc.LAST_SHARDING}")
         entry = {"n": n, "ok": True, "ips": round(budget / dt, 1),
-                 "select_sec": round(dt, 2)}
-        try:
-            stats = jax.local_devices()[0].memory_stats() or {}
-            peak = stats.get("peak_bytes_in_use")
-            if peak:
-                entry["peak_hbm_gb"] = round(peak / 2**30, 2)
-        except Exception:
-            pass
+                 "select_sec": round(dt, 2), "pool_sharding": layout}
+        # The attempt's true per-chip factor residency, analytically —
+        # the number the "equal per-chip HBM" comparison actually
+        # rests on (a row rung at n = ndev*m holds the same per-chip
+        # factor bytes as the replicated rung at m).
+        ways = use_mesh.devices.size if use_mesh is not None else 1
+        entry["factor_gb_per_chip"] = round(n * dim * 4 / ways / 2**30, 2)
+        per_chip, total = hbm_peaks()
+        if per_chip:
+            entry["peak_hbm_gb"] = round(per_chip / 2**30, 2)
+            entry["mesh_peak_hbm_gb"] = round(total / 2**30, 2)
+            if pre_peak is not None and per_chip <= pre_peak:
+                # peak_bytes_in_use is a PROCESS-LIFETIME high-water
+                # mark: an attempt that peaked below an earlier one
+                # (every row rung after the replicated climb hit the
+                # single-chip ceiling) reads the old mark, not its
+                # own.  Flag it — factor_gb_per_chip above carries the
+                # attempt's true residency either way.
+                entry["peak_hbm_carryover"] = True
         return entry
 
-    def record(entry):
-        result["attempts"].append(entry)
-        if entry["ok"] and entry["n"] > result["max_n"]:
-            result["max_n"] = entry["n"]
-            result["ips"] = result["ips_per_chip"] = entry["ips"]
+    def climb(steps, use_mesh, max_key):
+        """Ladder climb + two bisection steps; updates result[max_key]
+        and yields a snapshot after every attempt."""
+        lo, hi = 0, None  # largest success / smallest failure
 
-    lo, hi = 0, None  # largest success / smallest failure
-    for n in ladder:
-        try:
-            entry = attempt(n)
-        except Exception as e:
-            log(f"[kcenter_select_maxn] pool {n} failed: {e!r}")
-            result["attempts"].append({"n": n, "ok": False,
-                                       "error": repr(e)[:160]})
-            hi = n
+        def record(entry):
+            result["attempts"].append(entry)
+            if entry["ok"] and entry["n"] > result[max_key]:
+                result[max_key] = entry["n"]
+                # The headline follows the most capable climb that
+                # actually SUCCEEDED: the replicated rungs set it, row
+                # successes (climbed second, at ndev x the rows)
+                # overwrite it — so a row climb with no surviving rung
+                # still leaves the measured replicated ceiling on the
+                # line instead of a null headline.  Per-chip rate
+                # divides by the chips the entry's selection actually
+                # used: a replicated attempt runs on ONE device
+                # whatever the host holds.
+                div = n_chips if entry["pool_sharding"] == "row" else 1
+                result["ips"] = entry["ips"]
+                result["ips_per_chip"] = round(entry["ips"] / div, 1)
+
+        for n in steps:
+            try:
+                entry = attempt(n, use_mesh)
+            except Exception as e:
+                log(f"[kcenter_select_maxn] pool {n} failed: {e!r}")
+                result["attempts"].append(
+                    {"n": n, "ok": False, "error": repr(e)[:160],
+                     "pool_sharding": ("row" if use_mesh is not None
+                                       else "replicated")})
+                hi = n
+                yield dict(result)
+                break
+            record(entry)
+            lo = n
             yield dict(result)
-            break
-        record(entry)
-        lo = n
-        yield dict(result)
-    # Two bisection steps sharpen the boundary without unbounded retries.
-    for _ in range(2):
-        if hi is None or hi - lo <= max(lo // 8, 1):
-            break
-        mid = (lo + hi) // 2 // 2048 * 2048
-        if mid <= lo:
-            break
-        try:
-            entry = attempt(mid)
-        except Exception as e:
-            log(f"[kcenter_select_maxn] pool {mid} failed: {e!r}")
-            result["attempts"].append({"n": mid, "ok": False,
-                                       "error": repr(e)[:160]})
-            hi = mid
+        # Two bisection steps sharpen the boundary w/o unbounded retries.
+        for _ in range(2):
+            if hi is None or hi - lo <= max(lo // 8, 1):
+                break
+            mid = (lo + hi) // 2 // 2048 * 2048
+            if mid <= lo:
+                break
+            try:
+                entry = attempt(mid, use_mesh)
+            except Exception as e:
+                log(f"[kcenter_select_maxn] pool {mid} failed: {e!r}")
+                result["attempts"].append(
+                    {"n": mid, "ok": False, "error": repr(e)[:160],
+                     "pool_sharding": ("row" if use_mesh is not None
+                                       else "replicated")})
+                hi = mid
+                yield dict(result)
+                continue
+            record(entry)
+            lo = mid
             yield dict(result)
-            continue
-        record(entry)
-        lo = mid
+
+    # 1. The replicated (single-chip) envelope — the number DESIGN.md
+    # §3's N ~ 1.8M arithmetic must reproduce on a 16 GB chip.
+    yield from climb(ladder, None, "replicated_max_n")
+    result["no_partition_holds_to_n"] = result["replicated_max_n"]
+    if mesh is None:
+        result["max_n"] = result["replicated_max_n"]
         yield dict(result)
-    result["no_partition_holds_to_n"] = result["max_n"]
-    yield result
+        return
+    # 2. The row-sharded climb: same per-chip rows, ndev x the pool.
+    yield from climb(row_ladder, mesh, "max_n")
+    if result["replicated_max_n"] > 0 and result["max_n"] > 0:
+        scale = result["max_n"] / result["replicated_max_n"]
+        result["row_scale_x"] = round(scale, 2)
+        if n_chips >= 2:
+            # The acceptance gate (ISSUE 6): row sharding must SUSTAIN
+            # at least 2x the replicated ceiling at equal per-chip HBM
+            # (each row attempt holds replicated-sized shards per chip).
+            assert scale >= 2.0, (
+                f"row-sharded max_n {result['max_n']} is only "
+                f"{scale:.2f}x the replicated ceiling "
+                f"{result['replicated_max_n']} on {n_chips} devices")
+    elif result["max_n"] == 0:
+        # No row rung survived (a gate-refused mesh geometry, or the
+        # collectives' overhead pushed the first rung past the
+        # envelope): the phase's honest ceiling is the replicated one —
+        # emit it, tagged with the layout the headline now actually
+        # describes, rather than max_n=0/ips=null discarding the
+        # completed replicated climb.
+        result["max_n"] = result["replicated_max_n"]
+        result["pool_sharding"] = "replicated"
+    yield dict(result)
 
 
 def run_vaal_phase(epochs: int, per_chip: int):
@@ -1838,7 +1962,18 @@ def _load_cache() -> dict:
     try:
         with open(CACHE_PATH) as fh:
             cache = json.load(fh)
-        return cache if isinstance(cache, dict) else {}
+        if not isinstance(cache, dict):
+            return {}
+        for entry in cache.values():
+            # Pre-rename caches (<= PR 5) spell the resident warm rate
+            # ips_warm_resident; migrate on load so the canonical
+            # warm_resident_ips is the only spelling downstream — the
+            # same one-spelling rule as warm_memmap_ips, without an
+            # alias riding the evidence.
+            if isinstance(entry, dict) and "ips_warm_resident" in entry:
+                entry.setdefault("warm_resident_ips",
+                                 entry.pop("ips_warm_resident"))
+        return cache
     except (OSError, json.JSONDecodeError):
         return {}
 
@@ -1998,13 +2133,12 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
             c["unit"] = e["unit"]
         if e.get("cached"):
             c["cached"] = True
-        # The warm-round / warm-cache / backend / serving / feed numbers
-        # are round-level headline evidence — small enough to ride the
-        # line.  warm_memmap_ips is the canonical spelling of the
-        # datapath's steady-state rate; the deprecated ips_warm fallback
-        # keeps one release of old cache files readable.
+        # The warm-round / warm-cache / backend / serving / feed /
+        # pool-layout numbers are round-level headline evidence — small
+        # enough to ride the line.  warm_memmap_ips is the ONLY spelling
+        # of the datapath's steady-state rate (the deprecated ips_warm
+        # fallback is gone with its shim).
         for src, dst in (("warm_memmap_ips", "warm_ips"),
-                         ("ips_warm", "warm_ips"),
                          ("round_sec_warm", "warm_s"),
                          ("round_sec_cold", "cold_s"),
                          ("compile_tax_sec", "tax_s"),
@@ -2015,6 +2149,16 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                          ("step_time_ms_p50", "step_time_ms_p50"),
                          ("step_time_ms_p99", "step_time_ms_p99"),
                          ("backend", "be"),
+                         # The resident-pool layout rides the line only
+                         # where it is the phase's SUBJECT (the
+                         # sharded-ceiling probe) — a row-sharded max-N
+                         # is meaningless without the layout tag, but
+                         # claiming it on every selection phase pushed
+                         # the realistic-maximal line past the tail
+                         # bound (same rule as feed_source below; the
+                         # other phases keep it in the evidence file).
+                         *((("pool_sharding", "pool_sharding"),)
+                           if name == "kcenter_select_maxn" else ()),
                          # Feed attribution rides the line only where it
                          # is the phase's subject (the hierarchy
                          # comparison and the end-to-end rounds) — the

@@ -26,6 +26,17 @@ resident-gather train feed, DESIGN.md §2a):
      ``.asarray``/``.concatenate`` — so "train batches never touch the
      host" is a statically-checked property, not just a benched one.
 
+... and the sharded pool's SCALE-OUT invariant (row-sharded selection,
+DESIGN.md §2b):
+
+  6. ``strategies/kcenter.py`` must define every function in its
+     ``SHARDED_SELECTION_FNS``, and none of them may defeat the
+     sharding: no full-pool host materialization (``np.*`` references,
+     ``jax.device_get``, ``.asarray``) and no replication of a
+     row-sharded array (``replicate(`` / ``replicated_sharding(``
+     calls) — a 10.5 GB factor matrix pulled whole onto one host or
+     chip is exactly the ceiling the sharded backend exists to break.
+
 Stdlib only; exits 0 clean / 1 with findings on stderr.
 """
 
@@ -51,6 +62,26 @@ RESIDENT_FEED_FNS = ("_resident_feed_arrays", "_build_resident_batch_step")
 # Host-materialization markers forbidden inside those functions.
 _HOST_COPY_CALLS = {"gather", "asarray", "concatenate", "ascontiguousarray",
                     "stack", "copy"}
+
+KCENTER = os.path.join(PKG, "strategies", "kcenter.py")
+# The kcenter functions that ARE the row-sharded selection backend (the
+# module's own SHARDED_SELECTION_FNS names the device builder; this
+# mirror exists so the lint works without importing jax).  Each must
+# exist, and none may defeat the sharding.  Two rule sets:
+#   device tier (_build_sharded_fns — everything traced onto the mesh):
+#     no np.* at all, no jax.device_get/.asarray host fetches, no
+#     replicate/replicated_sharding calls;
+#   orchestrator tier (_kcenter_greedy_sharded — owns the HOST copy of
+#     the factors by design, so np index math is fine): no
+#     jax.device_get and no replicate/replicated_sharding — the device
+#     pool must never round-trip to host or be replicated per chip.
+# NOTE: lax.all_gather of the O(N) weight VECTOR is allowed (the
+# randomized D^2 draw needs the global weights); what is forbidden is
+# pulling the [N, D] factor matrix whole.
+SHARDED_DEVICE_FNS = ("_build_sharded_fns",)
+SHARDED_ORCHESTRATOR_FNS = ("_kcenter_greedy_sharded",)
+_SHARDED_HOST_CALLS = {"device_get", "asarray"}
+_SHARDED_REPLICATE_CALLS = {"replicate", "replicated_sharding"}
 
 
 def _py_files():
@@ -144,6 +175,9 @@ def check() -> list:
     # 5. The resident-gather train feed stays zero-host-copy.
     problems.extend(check_resident_feed())
 
+    # 6. The sharded selection backend never un-shards the pool.
+    problems.extend(check_sharded_selection())
+
     return problems
 
 
@@ -182,6 +216,65 @@ def check_resident_feed(trainer_path: str = TRAINER) -> list:
                     f"{rel}:{node.lineno}: {name} calls "
                     f".{node.func.attr}() — host materialization on the "
                     "resident train feed path")
+    return problems
+
+
+def check_sharded_selection(kcenter_path: str = KCENTER) -> list:
+    """The sharded pool's scale-out invariant, statically (check 6): the
+    row-sharded selection backend may move O(N) vectors and O(q) rows,
+    but a ``jax.device_get``/``np.asarray`` of the pool, an ``np.``
+    reference in the device tier, or a ``replicate``/
+    ``replicated_sharding`` call means the [N, D] factor matrix came
+    back whole onto one host or chip — the exact ceiling the backend
+    exists to break."""
+    problems = []
+    rel = os.path.relpath(kcenter_path, REPO)
+    try:
+        with open(kcenter_path) as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError) as e:
+        return [f"{rel}: unreadable for the sharded-selection check ({e})"]
+    fns = {node.name: node for node in ast.walk(tree)
+           if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def call_name(node) -> str:
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                return node.func.attr
+            if isinstance(node.func, ast.Name):
+                return node.func.id
+        return ""
+
+    for name in SHARDED_DEVICE_FNS + SHARDED_ORCHESTRATOR_FNS:
+        fn = fns.get(name)
+        if fn is None:
+            problems.append(
+                f"{rel}: sharded-selection function {name} not found — "
+                "the scale-out enforcement has nothing to check")
+            continue
+        device_tier = name in SHARDED_DEVICE_FNS
+        for node in ast.walk(fn):
+            if device_tier and isinstance(node, ast.Name) \
+                    and node.id == "np":
+                problems.append(
+                    f"{rel}:{node.lineno}: {name} references np — the "
+                    "sharded selection backend must never materialize "
+                    "pool state on the host")
+            called = call_name(node)
+            if device_tier and called in _SHARDED_HOST_CALLS:
+                problems.append(
+                    f"{rel}:{node.lineno}: {name} calls .{called}() — "
+                    "host materialization inside the sharded selection "
+                    "backend")
+            if not device_tier and called == "device_get":
+                problems.append(
+                    f"{rel}:{node.lineno}: {name} calls device_get — "
+                    "the sharded pool must never round-trip to host")
+            if called in _SHARDED_REPLICATE_CALLS:
+                problems.append(
+                    f"{rel}:{node.lineno}: {name} calls {called}() — "
+                    "replicating a row-sharded array rebuilds the "
+                    "single-chip ceiling the sharded pool removes")
     return problems
 
 

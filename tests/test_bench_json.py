@@ -52,8 +52,10 @@ class TestDegradedModeLine:
         assert lines, "bench printed nothing to stdout"
         line = lines[-1]
         # The harness-tail bound: ~2000 bytes of stdout tail, nothing on
-        # stdout but this line — 1600 leaves 400 bytes of slop margin.
-        assert len(line.encode()) <= 1600
+        # stdout but this line — 1680 leaves 320 bytes of slop margin
+        # (raised with the ISSUE 6 riders; margin math at
+        # bench.MAX_LINE_BYTES).
+        assert len(line.encode()) <= 1680
         out = json.loads(line)  # strict: NaN/Inf tokens would raise
         for key in REQUIRED_KEYS:
             assert key in out, f"missing {key!r} in {sorted(out)}"
@@ -111,9 +113,11 @@ class TestDegradedModeLine:
 
     def test_feed_fields_and_datapath_rename_ride_the_line(self, tmp_path):
         """The feed-hierarchy numbers (imagenet_train_feed, feed_source/
-        feed_stall_frac on train + al_round phases) and the datapath's
-        renamed warm field (warm_memmap_ips, nee ips_warm — the cold/warm
-        naming-trap fix) must all surface on the compact line."""
+        feed_stall_frac on train + al_round phases), the datapath's
+        canonical warm field (warm_memmap_ips — its deprecated ips_warm
+        alias and the deprecated_keys shim are GONE after their one
+        release), and the selection probe's pool_sharding layout tag
+        must all surface on the compact line."""
         base = {"n_chips": 1, "device_kind": "cpu", "platform": "cpu",
                 "captured_utc": "2026-01-01T00:00:00Z"}
         cache = {
@@ -126,15 +130,23 @@ class TestDegradedModeLine:
             "imagenet_datapath": dict(
                 base, phase="imagenet_datapath", ips=348.6,
                 ips_per_chip=348.6, batch_per_chip=128,
-                # Canonical name ONLY (no deprecated ips_warm): the
-                # fallback must not be required for the line to carry it.
+                # Canonical name ONLY: no shim exists anymore, and a
+                # stale legacy-only spelling must NOT ride (below).
                 cold_populate_ips=348.6, warm_memmap_ips=157.7,
-                deprecated_keys={"ips_warm": "renamed warm_memmap_ips"}),
+                ips_warm=999.9),
             "al_round_cifar": dict(
                 base, phase="al_round_cifar", ips=400.0,
                 ips_per_chip=400.0, batch_per_chip=128,
                 round_sec_warm=22.0, round_sec_cold=80.0,
                 feed_source="resident", feed_stall_frac=0.01),
+            # n_chips stays 1 (the cache rides only when the entry's
+            # hardware matches the live 1-device CPU probe); the layout
+            # tag is what's being plumbed here.
+            "kcenter_select_maxn": dict(
+                base, phase="kcenter_select_maxn", ips=120.0,
+                ips_per_chip=120.0, unit="picks/sec",
+                pool_sharding="row", max_n=2_560_000,
+                replicated_max_n=1_280_000, row_scale_x=2.0),
         }
         (tmp_path / "bench_cache.json").write_text(json.dumps(cache))
         proc = _run_bench(tmp_path)
@@ -149,10 +161,38 @@ class TestDegradedModeLine:
                                 pytest.approx(900.0),
                                 pytest.approx(400.0)]
         dp = out["phases"]["imagenet_datapath"]
+        # The canonical spelling rides; the legacy alias in the cache
+        # entry above is ignored — not renamed, not forwarded.
         assert dp["warm_ips"] == pytest.approx(157.7)
         rd = out["phases"]["al_round_cifar"]
         assert rd["feed"] == "resident"
         assert rd["stall"] == pytest.approx(0.01)
+        # The sharded-pool probe's layout attribution (ISSUE 6): a
+        # row-sharded max-N claim is meaningless without the layout tag.
+        assert out["phases"]["kcenter_select_maxn"][
+            "pool_sharding"] == "row"
+
+    def test_legacy_ips_warm_alias_no_longer_rides(self, tmp_path):
+        """A pre-rename cache entry carrying ONLY the deprecated
+        ips_warm spelling gets no warm_ips on the line: the one-release
+        compatibility shim is removed, so stale captures surface their
+        headline ips but not a silently-renamed warm figure."""
+        cache = {
+            "imagenet_datapath": {
+                "phase": "imagenet_datapath", "ips": 348.6,
+                "ips_per_chip": 348.6, "batch_per_chip": 128,
+                "n_chips": 1, "device_kind": "cpu", "platform": "cpu",
+                "ips_warm": 157.7,
+                "captured_utc": "2026-01-01T00:00:00Z",
+            }
+        }
+        (tmp_path / "bench_cache.json").write_text(json.dumps(cache))
+        proc = _run_bench(tmp_path)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        dp = out["phases"]["imagenet_datapath"]
+        assert dp["ips"] == pytest.approx(348.6)
+        assert "warm_ips" not in dp
 
     def test_state_dir_redirect_leaves_repo_files_alone(self, tmp_path):
         """The redirect itself: nothing in the repo root may be touched
@@ -168,3 +208,58 @@ class TestDegradedModeLine:
             assert os.path.getmtime(os.path.join(repo, name)) == mtime
         assert (tmp_path / "bench_partial.json").exists() or \
             (tmp_path / "bench_evidence.json").exists()
+
+
+class TestCacheKeyMigration:
+    def test_pre_rename_warm_resident_key_migrates_on_load(
+            self, tmp_path, monkeypatch):
+        """A <= PR 5 cache spelling the resident warm rate
+        ips_warm_resident loads under the canonical warm_resident_ips —
+        the datum survives the rename without an alias riding the
+        evidence (the same one-spelling rule as warm_memmap_ips)."""
+        sys.path.insert(0, os.path.dirname(os.path.abspath(BENCH)))
+        try:
+            import bench as bench_mod
+        finally:
+            sys.path.pop(0)
+        cache = {"resnet18_cifar_score": {
+            "phase": "resnet18_cifar_score", "ips": 1000.0,
+            "ips_warm_resident": 4242.0}}
+        path = tmp_path / "bench_cache.json"
+        path.write_text(json.dumps(cache))
+        monkeypatch.setattr(bench_mod, "CACHE_PATH", str(path))
+        entry = bench_mod._load_cache()["resnet18_cifar_score"]
+        assert entry["warm_resident_ips"] == pytest.approx(4242.0)
+        assert "ips_warm_resident" not in entry
+        # The canonical spelling, already present, is never clobbered.
+        path.write_text(json.dumps({"resnet18_cifar_score": {
+            "warm_resident_ips": 1.0, "ips_warm_resident": 2.0}}))
+        entry = bench_mod._load_cache()["resnet18_cifar_score"]
+        assert entry["warm_resident_ips"] == pytest.approx(1.0)
+
+
+class TestMaxnHeadlineFallback:
+    def test_row_climb_with_no_surviving_rung_keeps_replicated_headline(
+            self, monkeypatch):
+        """A mesh geometry every row rung is refused on (the gate says
+        the bucketed pool can't split) must not null the headline: the
+        completed replicated climb's ceiling and picks/sec ride the
+        line, tagged with the layout they actually describe, and the
+        refusals are recorded as failed attempts before any compute."""
+        sys.path.insert(0, os.path.dirname(os.path.abspath(BENCH)))
+        try:
+            import bench as bench_mod
+        finally:
+            sys.path.pop(0)
+        from active_learning_tpu.strategies import kcenter as kc
+        monkeypatch.setattr(kc, "row_capable", lambda *a, **k: False)
+        out = list(bench_mod.run_kcenter_maxn_phase(8, dim=4))[-1]
+        assert out["replicated_max_n"] > 0
+        assert out["max_n"] == out["replicated_max_n"]
+        assert out["pool_sharding"] == "replicated"
+        assert out["ips"] is not None
+        assert "row_scale_x" not in out
+        rows = [a for a in out["attempts"]
+                if a["pool_sharding"] == "row"]
+        assert rows and not any(a["ok"] for a in rows)
+        assert "row layout unavailable" in rows[0]["error"]

@@ -78,6 +78,47 @@ class TestKCenterCompileReuse:
         assert _cache_size(kc._kcenter_scan_batched) == scan + 1
 
 
+class TestShardedKCenterCompileReuse:
+    """The row-sharded selection backend under the same bucket contract:
+    warm AL rounds (drifted pool size, grown labeled set, same bucket)
+    add ZERO compiles to the per-mesh sharded executables."""
+
+    def _run(self, mesh, n, n_labeled, budget, seed=0, batch_q=8):
+        from active_learning_tpu.strategies import kcenter as kc
+        rng = np.random.default_rng(seed)
+        emb = rng.normal(size=(n, 24)).astype(np.float32)
+        labeled = np.zeros(n, dtype=bool)
+        labeled[rng.choice(n, n_labeled, replace=False)] = True
+        picks = kc.kcenter_greedy((emb,), labeled, budget,
+                                  rng=np.random.default_rng(1),
+                                  batch_q=batch_q, mesh=mesh,
+                                  pool_sharding="row")
+        assert kc.LAST_SHARDING == "row"
+        assert len(picks) == budget
+
+    def test_grown_pool_same_bucket_zero_new_compiles(self):
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.strategies import kcenter as kc
+
+        mesh = mesh_lib.make_mesh()
+        self._run(mesh, 300, 20, 10)  # pool bucket 512, warm
+        fns = kc._SHARDED_JITS[(mesh, 1)]
+        sizes = {k: _cache_size(v) for k, v in fns.items()}
+        self._run(mesh, 340, 50, 10, seed=5)  # grown; same 512 bucket
+        assert {k: _cache_size(v) for k, v in fns.items()} == sizes
+
+    def test_bucket_boundary_recompiles_scan_once(self):
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.strategies import kcenter as kc
+
+        mesh = mesh_lib.make_mesh()
+        self._run(mesh, 300, 20, 10)
+        fns = kc._SHARDED_JITS[(mesh, 1)]
+        scan = _cache_size(fns["scan_batched"])
+        self._run(mesh, 600, 20, 10, seed=6)  # crosses into 1024
+        assert _cache_size(fns["scan_batched"]) == scan + 1
+
+
 class TestEpochScanCompileReuse:
     def test_two_rounds_grown_labeled_zero_new_compiles(self):
         """The device-resident epoch scan across two AL 'rounds' whose
@@ -125,6 +166,47 @@ class TestEpochScanCompileReuse:
         # The case the pure-pow2 rule got wrong: 157 steps must not pay
         # 99 masked-but-executed train steps per epoch (256), only 3.
         assert Trainer.bucket_steps(157) == 160
+
+
+class TestShardedFeedCompileReuse:
+    def test_warm_rounds_on_row_sharded_feed_zero_new_compiles(self):
+        """Warm AL rounds under row sharding add zero XLA compiles: the
+        pool entry (constant shape) and the sharded per-batch step are
+        both reused round over round — the jit-cache delta invariant of
+        test_telemetry, pinned directly on the executables here."""
+        import dataclasses
+        from helpers import TinyClassifier, tiny_train_config
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.parallel import resident as resident_lib
+        from active_learning_tpu.train.trainer import Trainer
+
+        train_set, _, al_set = get_data_synthetic(n_train=96, n_test=16)
+        cfg = dataclasses.replace(tiny_train_config(batch_size=16),
+                                  train_feed="resident",
+                                  pool_sharding="row")
+        mesh = mesh_lib.make_mesh()
+        trainer = Trainer(TinyClassifier(), cfg, mesh, 4)
+        assert trainer.pool_sharding == "row"
+
+        def fit_round(n_labeled, seed):
+            state = trainer.init_state(jax.random.PRNGKey(seed),
+                                       train_set.gather(np.arange(2)))
+            rng = np.random.default_rng(seed)
+            labeled = np.sort(rng.choice(96, n_labeled, replace=False))
+            return trainer.fit(state, train_set, labeled, al_set,
+                               np.arange(90, 96), n_epoch=2,
+                               es_patience=0, rng=rng)
+
+        fit_round(24, 0)  # round N: pins the pool, compiles the step
+        assert trainer.last_feed["source"] == "resident"
+        assert resident_lib.pinned_bytes(trainer.resident_pool) > 0
+        step = _cache_size(trainer._resident_batch_step)
+        entries = len(trainer.resident_pool["images"])
+        fit_round(60, 1)  # round N+1: grown labeled set, same pool
+        assert trainer.last_feed["source"] == "resident"
+        assert _cache_size(trainer._resident_batch_step) == step
+        assert len(trainer.resident_pool["images"]) == entries
 
 
 class TestResidentBudgetDemotion:

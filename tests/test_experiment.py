@@ -785,7 +785,9 @@ class TestBenchEvidence:
         if name.endswith("_train"):
             extra.update(feed_source="resident", feed_stall_frac=0.0)
         if name == "imagenet_datapath":
-            extra.update(ips_warm=9000.1, warm_memmap_ips=9000.1,
+            # Canonical names only: the ips_warm alias and its
+            # deprecated_keys shim are gone (kept one release, PR 5).
+            extra.update(warm_memmap_ips=9000.1,
                          cold_populate_ips=100.0, decode_ips=1047.8)
         if name == "imagenet_train_feed":
             extra.update(unit="train images/sec (in-fit)",
@@ -797,8 +799,17 @@ class TestBenchEvidence:
                          test_accuracy_rd1=0.8125,
                          feed_source="resident", feed_stall_frac=0.02,
                          phases_sec={"round0": {"train_time": 100.0}})
-        if name == "kcenter_select":
-            extra.update(unit="picks/sec", backend="xla-batched")
+        if name.startswith("kcenter_select"):
+            # Every selection phase now attributes its pool layout
+            # alongside the scan backend (ISSUE 6).
+            extra.update(unit="picks/sec", backend="xla-batched",
+                         pool_sharding="row")
+        if name == "kcenter_select_maxn":
+            # The sharded-pool probe's extra evidence: the row-vs-
+            # replicated ceiling comparison (file-only; pool_sharding
+            # is the field that rides the line).
+            extra.update(max_n=2_560_000, replicated_max_n=1_280_000,
+                         row_scale_x=2.0)
         if name == "serve_throughput":
             extra.update(unit="scored images/sec (served)",
                          qps_closed=137.2, p99_ms_closed=25.0,

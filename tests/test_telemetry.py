@@ -532,6 +532,60 @@ class TestTraceLint:
         # above, but pinned here against the specific check).
         assert lint.check_resident_feed() == []
 
+    def test_lint_flags_unsharding_on_sharded_selection_path(self,
+                                                             tmp_path):
+        """The sharded pool's scale-out invariant (check 6, DESIGN.md
+        §2b): a sharded-selection function that pulls the pool to host
+        (np in the device tier, jax.device_get anywhere) or replicates
+        a row-sharded array must fail the lint; deleting a function
+        drops to 'not found' — the enforcement cannot be renamed away."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_lint", os.path.join(REPO, "scripts", "trace_lint.py"))
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+
+        bad = tmp_path / "kcenter.py"
+        bad.write_text(
+            "import numpy as np\n"
+            "import jax\n"
+            "def _build_sharded_fns(mesh, nf):\n"
+            "    rows = np.asarray(jax.device_get(mesh))\n"
+            "    return rows\n"
+            "def _kcenter_greedy_sharded(factors, mask, budget):\n"
+            "    full = jax.device_get(factors)\n"
+            "    rep = mesh_lib.replicate(factors, None)\n"
+            "    return full, rep\n")
+        problems = lint.check_sharded_selection(str(bad))
+        assert any("references np" in p for p in problems)
+        assert any(".device_get()" in p or "device_get" in p
+                   for p in problems)
+        assert any("replicate()" in p for p in problems)
+
+        # The orchestrator tier ALLOWS np (it owns the host factor
+        # copy) — only fetches/replication are flagged there.
+        ok_np = tmp_path / "kcenter_np_ok.py"
+        ok_np.write_text(
+            "import numpy as np\n"
+            "def _build_sharded_fns(mesh, nf):\n"
+            "    return mesh\n"
+            "def _kcenter_greedy_sharded(factors, mask, budget):\n"
+            "    return np.flatnonzero(mask)\n")
+        assert lint.check_sharded_selection(str(ok_np)) == []
+
+        empty = tmp_path / "empty_kcenter.py"
+        empty.write_text("def unrelated():\n    pass\n")
+        problems = lint.check_sharded_selection(str(empty))
+        assert any("not found" in p for p in problems)
+
+        # The REAL backend is clean, and the module's own fn list stays
+        # in lockstep with the lint's mirror (renames can't silently
+        # drop enforcement on either side).
+        assert lint.check_sharded_selection() == []
+        from active_learning_tpu.strategies import kcenter as kc
+        assert set(kc.SHARDED_SELECTION_FNS) == set(
+            lint.SHARDED_DEVICE_FNS + lint.SHARDED_ORCHESTRATOR_FNS)
+
 
 class TestSatelliteFixes:
     def test_setup_logging_appends_on_resume(self, tmp_path):
