@@ -400,6 +400,19 @@ class ExperimentConfig:
     # reference's DataLoader num_workers row).
     feed_workers: Optional[int] = None
 
+    # Pipelined AL round (experiment/pipeline.py, DESIGN.md §8):
+    # "speculative" overlaps the next query's pool-scoring pass with the
+    # current fit's early-stop patience tail (chunks scored from each
+    # published best checkpoint, invalidated when a later epoch improves
+    # best) and prefetches the coming fit's train feed while selection
+    # runs — round wall moves from sum(train, score, select) toward
+    # max(train, score).  "off" is the reference's strictly sequential
+    # loop.  "auto" (the default) picks speculative on any
+    # single-process multi-device mesh.  Picks, scores, and
+    # experiment_state are bit-identical across modes at the same seeds
+    # (tests/test_pipeline.py) — this is a wall-clock choice only.
+    round_pipeline: str = "auto"
+
     # Coreset / BADGE partitioning (parser.py:74-79)
     subset_labeled: Optional[int] = None
     subset_unlabeled: Optional[int] = None

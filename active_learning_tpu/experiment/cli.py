@@ -140,6 +140,17 @@ def get_parser() -> argparse.ArgumentParser:
                    help="gather/decode worker threads for the host train "
                         "feed (the reference DataLoader's num_workers); "
                         "default defers to the arg pool's train loader")
+    p.add_argument("--round_pipeline", type=str, default="auto",
+                   choices=["auto", "off", "speculative"],
+                   help="pipelined AL round: speculative overlaps the "
+                        "next query's pool scoring with the fit's "
+                        "early-stop patience tail (restarting from any "
+                        "later best checkpoint) and prefetches the "
+                        "coming fit's feed while selection runs.  auto "
+                        "(the default) picks speculative on any "
+                        "single-process multi-device mesh.  Picks and "
+                        "experiment state are bit-identical to off at "
+                        "the same seeds — wall-clock only")
     # Coreset / BADGE scale controls (parser.py:74-79)
     p.add_argument("--subset_labeled", type=int, default=None)
     p.add_argument("--subset_unlabeled", type=int, default=None)
@@ -216,6 +227,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         train_feed=args.train_feed,
         pool_sharding=args.pool_sharding,
         feed_workers=args.feed_workers,
+        round_pipeline=args.round_pipeline,
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,
         partitions=args.partitions,

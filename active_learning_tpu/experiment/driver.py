@@ -43,6 +43,7 @@ from ..utils.metrics import MetricsSink, make_sink
 from ..utils.tracing import phase_timer, profiler_session
 from ..train.trainer import Trainer
 from . import arg_pools as arg_pools_lib
+from . import pipeline as pipeline_lib
 from . import resume as resume_lib
 
 
@@ -236,6 +237,37 @@ def build_experiment(
     return strategy
 
 
+def _emit_overlap_telemetry(telemetry, sink: MetricsSink, rd: int,
+                            round_s: float, phase_s: dict,
+                            spec_s: float, pipeline_mode: str) -> None:
+    """The pipelined round's proof-of-overlap metrics, from the driver's
+    OWN telemetry stream (bench reads these back rather than timing the
+    loop again):
+
+      rd_round_time       the round span's wall;
+      overlap_frac        1 − round / (Σ phase walls + speculative-
+                          scorer busy) — the fraction of serial-
+                          equivalent work hidden by overlap (a
+                          sequential round reads ~0);
+      round_vs_max_phase  round / max(phase, spec) — 1.0 is the
+                          theoretical floor (round == its longest
+                          stream), the sum/max gap still on the table.
+    """
+    if not telemetry.train_metrics or not phase_s:
+        return
+    serial = sum(phase_s.values()) + spec_s
+    longest = max(max(phase_s.values()), spec_s)
+    if serial <= 0 or longest <= 0:
+        return
+    sink.log_metric("rd_round_time", round(round_s, 3), step=rd)
+    sink.log_metric("overlap_frac",
+                    round(max(0.0, 1.0 - round_s / serial), 4), step=rd)
+    sink.log_metric("round_vs_max_phase", round(round_s / longest, 3),
+                    step=rd)
+    if pipeline_mode != "off":
+        sink.log_metric("rd_spec_score_time", round(spec_s, 3), step=rd)
+
+
 def _emit_round_telemetry(telemetry, sink: MetricsSink, rd: int,
                           strategy) -> None:
     """Round-boundary telemetry: the jit-compile miss delta (round 0
@@ -343,6 +375,7 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     # UNINSTALLS it — an exception anywhere, including setup, must not
     # leak an installed runtime into the next in-process run.
     status = "crashed"
+    pipeline = None
     try:
         strategy = build_experiment(cfg, sink=sink, data=data, mesh=mesh,
                                     train_cfg=train_cfg, model=model,
@@ -366,13 +399,30 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         logger.info(f"Log file name: {log_filename}")
         logger.info(f"Mesh: {strategy.mesh.devices.size} devices")
 
+        # The pipelined round coordinator (experiment/pipeline.py,
+        # DESIGN.md §8): armed before each fit so the next query's pool
+        # scoring overlaps the fit's patience tail, consumed by
+        # Strategy.collect_scores at the next query.  Installed on the
+        # strategy (train() wires the best-ckpt publish into fit);
+        # bit-identical to the sequential loop by contract.
+        pipeline_mode = pipeline_lib.resolve_round_pipeline(
+            cfg.round_pipeline, strategy.mesh)
+        if pipeline_mode == "speculative":
+            pipeline = pipeline_lib.RoundPipeline(strategy)
+            strategy.pipeline = pipeline
+        logger.info(f"Round pipeline: {pipeline_mode}")
+
         with profiler_session(cfg.profile_dir), \
                 tele_spans.get_tracer().span(
                     "experiment", args={"exp_name": cfg.exp_name,
                                         "exp_hash": cfg.exp_hash}):
             for rd in range(start_round, cfg.rounds):
-                with tele_spans.get_tracer().span("round",
-                                                  args={"round": rd}):
+                # Per-phase walls for the overlap accounting, read from
+                # the SAME spans phase_timer records (one measurement:
+                # metric, log, trace, and overlap_frac all agree).
+                phase_s = {}
+                with tele_spans.get_tracer().span(
+                        "round", args={"round": rd}) as round_sp:
                     strategy.round = rd
                     telemetry.tick(force=True, round=rd,
                                    phase="round_start", epoch=0, step=0)
@@ -397,31 +447,65 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
                     if rd > 0 or al_round_0:
                         if al_round_0:
                             strategy.init_network_weights()
-                        with phase_timer("query_time", rd, sink, logger):
+                        with phase_timer("query_time", rd, sink,
+                                         logger) as sp:
                             labeled_idxs, cur_cost = strategy.query(
                                 cfg.round_budget)
+                        phase_s["query"] = sp.duration_s
                         strategy.update(labeled_idxs, cur_cost)
 
                     with phase_timer("init_network_weights_time", rd, sink,
-                                     logger):
+                                     logger) as sp:
                         strategy.init_network_weights()
-                    with phase_timer("train_time", rd, sink, logger):
+                    phase_s["init"] = sp.duration_s
+                    # Arm the speculative plan for the NEXT round's query
+                    # before the fit starts publishing best checkpoints —
+                    # the scorer overlaps the fit's patience tail.  The
+                    # last round has no next query: nothing to speculate.
+                    if pipeline is not None and rd + 1 < cfg.rounds:
+                        pipeline.arm(rd)
+                    with phase_timer("train_time", rd, sink, logger) as sp:
                         strategy.train()
+                    phase_s["train"] = sp.duration_s
                     with phase_timer("load_best_ckpt_time", rd, sink,
-                                     logger):
+                                     logger) as sp:
                         strategy.load_best_ckpt()
-                    with phase_timer("test_time", rd, sink, logger):
+                    phase_s["load_best"] = sp.duration_s
+                    with phase_timer("test_time", rd, sink, logger) as sp:
                         strategy.test()
+                    phase_s["test"] = sp.duration_s
 
                     if mesh_lib.is_coordinator():
                         resume_lib.save_experiment(strategy, cfg)
                     cfg.resume_training = True  # crash after this resumes (main_al.py:181)
+                if pipeline is not None:
+                    # Scorer busy minus the round's gate contention on
+                    # BOTH sides: chunk busy already excludes the
+                    # scorer's own gate waits (pipeline._score_chunk),
+                    # and the main thread's waits on scorer holds are
+                    # inside the phase walls — leaving them in spec_s
+                    # would double-count serialized time as overlap
+                    # (most visible in drain-mode CPU rounds, where a
+                    # chunk's whole execution can stall the fit).
+                    spec_s = max(
+                        0.0, pipeline.take_busy_s()
+                        - strategy.trainer.dispatch_lock.take_wait_s())
+                else:
+                    spec_s = 0.0
+                _emit_overlap_telemetry(
+                    telemetry, sink, rd, round_sp.duration_s, phase_s,
+                    spec_s, pipeline_mode)
                 _emit_round_telemetry(telemetry, sink, rd, strategy)
                 if len(strategy.available_query_idxs(shuffle=False)) == 0:
                     logger.info("Finished querying all Images!")
                     break
         status = "finished"
     finally:
+        # Stop the speculative scorer BEFORE telemetry teardown: its
+        # thread ticks the heartbeat and records spans, both of which
+        # must not outlive the run they belong to.
+        if pipeline is not None:
+            pipeline.shutdown()
         telemetry.finish(status)
         tele_runtime.uninstall(telemetry)
     return strategy

@@ -18,6 +18,8 @@ persistent JAX runtime and a `jax.sharding.Mesh`:
 from __future__ import annotations
 
 import os
+import threading
+import time
 from typing import Any, Dict, Optional, Sequence
 
 import jax
@@ -61,6 +63,80 @@ def is_multiprocess(mesh: Mesh) -> bool:
     """True when ``mesh`` spans devices of more than one process."""
     me = jax.process_index()
     return any(d.process_index != me for d in mesh.devices.flat)
+
+
+class DispatchGate:
+    """ONE enqueue order for collective-bearing dispatches when two host
+    threads share a mesh (the pipelined round's speculative scorer +
+    the trainer — experiment/pipeline.py, DESIGN.md §8).
+
+    Used as a context manager around each jitted dispatch.  Two tiers of
+    protection, matched to what each backend actually guarantees:
+
+      * **Enqueue ordering (always).**  The lock makes every device see
+        the two streams' computations enqueued in one global order.  On
+        TPU that is sufficient: each core executes its enqueued programs
+        in FIFO order, so collectives from different executables can
+        never interleave across cores.
+      * **Execution draining (``drain_mode``, CPU meshes only).**
+        XLA:CPU does NOT preserve enqueue order at execution — device
+        programs run on one shared thread pool, so computation A's
+        program on core 2 can be parked behind computation B's while
+        B's core-0 program waits on A's rendezvous: a cross-thread
+        collective deadlock (observed live; two AllReduce run_ids
+        mutually stuck).  When ``drain_mode`` is on, the dispatch site
+        calls ``drain(out)`` BEFORE releasing the gate, so at most one
+        collective-bearing computation is ever in flight.  The scorer
+        arms it for exactly the window it shares the mesh
+        (RoundPipeline.arm -> consume); single-threaded phases and
+        sequential rounds never pay the sync.
+
+    Reentrant so a dispatch site may nest helpers that also take the
+    gate."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # Flipped by the pipelined round on CPU meshes only; plain bool
+        # write/read (atomic under the GIL).
+        self.drain_mode = False
+        # Per-thread seconds spent BLOCKED acquiring the gate — i.e.
+        # stalled on the other stream's hold.  The overlap accounting
+        # reads this to avoid claiming scorer time that actually
+        # serialized with the train stream (and vice versa) as overlap.
+        self._waits: Dict[int, float] = {}
+        self._waits_lock = threading.Lock()
+
+    def __enter__(self) -> "DispatchGate":
+        # Uncontended (and reentrant-by-holder) acquires take the fast
+        # path: no clock read, no wait recorded.
+        if not self._lock.acquire(blocking=False):
+            t0 = time.perf_counter()
+            self._lock.acquire()
+            dt = time.perf_counter() - t0
+            tid = threading.get_ident()
+            with self._waits_lock:
+                self._waits[tid] = self._waits.get(tid, 0.0) + dt
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._lock.release()
+        return False
+
+    def take_wait_s(self) -> float:
+        """Seconds THIS thread spent blocked acquiring the gate since
+        its last take (reset on read) — the contention the other
+        stream's holds cost it."""
+        with self._waits_lock:
+            return self._waits.pop(threading.get_ident(), 0.0)
+
+    def drain(self, tree: Any) -> Any:
+        """Block until ``tree``'s arrays are computed — only in drain
+        mode (see above); a no-op everywhere else, preserving the async
+        dispatch the trainer's deferred loss materialization relies
+        on.  Call while still HOLDING the gate."""
+        if self.drain_mode:
+            jax.block_until_ready(tree)
+        return tree
 
 
 def process_local_rows(mesh: Mesh, batch_size: int) -> slice:

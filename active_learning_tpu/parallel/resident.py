@@ -41,6 +41,7 @@ replaces a host->device transfer.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -50,6 +51,19 @@ from jax.sharding import PartitionSpec as P
 
 from . import mesh as mesh_lib
 from ..utils.logging import get_logger
+
+# The resident cache has CONCURRENT consumers since the pipelined round
+# (experiment/pipeline.py): the speculative scorer thread and the
+# trainer's per-epoch validation can both resolve the same pool entry
+# while training runs.  One process-wide lock around cache mutation
+# (first upload, runner build, LRU touch, budget demotion) AND the
+# accounting reads that iterate the entry dict (pinned_bytes, cached)
+# keeps "upload once per experiment" true under that concurrency and
+# keeps a reader from hitting "dict changed size during iteration"
+# while the other thread inserts.  Reads of an existing entry still pay
+# only the lock handshake.  Reentrant: enforce_budget calls
+# pinned_bytes under the lock.
+_CACHE_LOCK = threading.RLock()
 
 # HBM held back from the auto-sized resident budget: training activations,
 # XLA workspace, and the model/optimizer trees all coexist with a pinned
@@ -161,8 +175,9 @@ def pinned_bytes(cache: Optional[Dict]) -> int:
     figure either way)."""
     if not cache:
         return 0
-    return sum(_per_device_bytes(entry[1])
-               for entry in cache.get("images", {}).values())
+    with _CACHE_LOCK:
+        return sum(_per_device_bytes(entry[1])
+                   for entry in cache.get("images", {}).values())
 
 
 def eligible(dataset: Any, max_bytes: int,
@@ -223,33 +238,34 @@ def pool_arrays(cache: Dict, dataset: Any, mesh,
     replicate()/shard_rows device_put EXPLICITLY (transfer-guard
     friendly).  Every access refreshes the entry's position in the LRU
     eviction order."""
-    images = cache.setdefault("images", {})
-    n = len(dataset)
-    key = (id(dataset.images), n)
-    if key not in images:
-        if sharding == "row" and mesh.devices.size > 1 \
-                and not mesh_lib.is_multiprocess(mesh):
-            # No ascontiguousarray here: shard_rows slices per shard
-            # (and makes each block contiguous itself), so the one big
-            # host copy the replicated path pays is exactly what the
-            # row path avoids.
-            images[key] = (
-                dataset,
-                mesh_lib.shard_rows(dataset.images[:n], mesh),
-                mesh_lib.shard_rows(
-                    dataset.targets[:n].astype(np.int32), mesh))
-        else:
-            images[key] = (
-                dataset,
-                mesh_lib.replicate(
-                    np.ascontiguousarray(dataset.images[:n]), mesh),
-                mesh_lib.replicate(
-                    dataset.targets[:n].astype(np.int32), mesh))
-    lru = cache.setdefault("lru", [])
-    if key in lru:
-        lru.remove(key)
-    lru.append(key)
-    return images[key][1], images[key][2]
+    with _CACHE_LOCK:
+        images = cache.setdefault("images", {})
+        n = len(dataset)
+        key = (id(dataset.images), n)
+        if key not in images:
+            if sharding == "row" and mesh.devices.size > 1 \
+                    and not mesh_lib.is_multiprocess(mesh):
+                # No ascontiguousarray here: shard_rows slices per shard
+                # (and makes each block contiguous itself), so the one
+                # big host copy the replicated path pays is exactly what
+                # the row path avoids.
+                images[key] = (
+                    dataset,
+                    mesh_lib.shard_rows(dataset.images[:n], mesh),
+                    mesh_lib.shard_rows(
+                        dataset.targets[:n].astype(np.int32), mesh))
+            else:
+                images[key] = (
+                    dataset,
+                    mesh_lib.replicate(
+                        np.ascontiguousarray(dataset.images[:n]), mesh),
+                    mesh_lib.replicate(
+                        dataset.targets[:n].astype(np.int32), mesh))
+        lru = cache.setdefault("lru", [])
+        if key in lru:
+            lru.remove(key)
+        lru.append(key)
+        return images[key][1], images[key][2]
 
 
 def sharded_pool_gather(images, ids, mesh, labels=None):
@@ -305,15 +321,16 @@ def enforce_budget(cache: Optional[Dict], max_bytes: int) -> list:
     residency.  Returns the demoted keys."""
     if not cache:
         return []
-    images = cache.get("images", {})
-    lru = cache.get("lru", [])
     demoted = []
-    while images and pinned_bytes(cache) > max(0, int(max_bytes)):
-        key = next((k for k in lru if k in images), next(iter(images)))
-        images.pop(key)
-        if key in lru:
-            lru.remove(key)
-        demoted.append(key)
+    with _CACHE_LOCK:
+        images = cache.get("images", {})
+        lru = cache.get("lru", [])
+        while images and pinned_bytes(cache) > max(0, int(max_bytes)):
+            key = next((k for k in lru if k in images), next(iter(images)))
+            images.pop(key)
+            if key in lru:
+                lru.remove(key)
+            demoted.append(key)
     if demoted:
         get_logger().info(
             f"resident pool budget shrank to {max_bytes / 1e9:.2f} GB: "
@@ -332,35 +349,40 @@ def get_runner(cache: Dict, step_fn: Callable, mesh,
     owner psum instead of a full-array index — landing the batch in the
     SAME batch sharding, so the step partitions identically and scores
     are bit-identical across pool layouts."""
-    steps = cache.setdefault("steps", {})
     key = (id(step_fn), with_labels, bool(sharded))
-    if key not in steps:
-        batch_sharding = mesh_lib.batch_sharding(mesh)
+    with _CACHE_LOCK:
+        steps = cache.setdefault("steps", {})
+        if key in steps:
+            return steps[key]
+    batch_sharding = mesh_lib.batch_sharding(mesh)
 
-        if with_labels:
+    if with_labels:
 
-            @jax.jit
-            def run(variables, images, labels, ids, mask):
-                if sharded:
-                    img, lab = sharded_pool_gather(images, ids, mesh,
-                                                   labels=labels)
-                else:
-                    img = jax.lax.with_sharding_constraint(
-                        images[ids], batch_sharding)
-                    lab = labels[ids]
-                batch = {"image": img, "label": lab, "mask": mask}
-                return step_fn(variables, batch)
-        else:
+        @jax.jit
+        def run(variables, images, labels, ids, mask):
+            if sharded:
+                img, lab = sharded_pool_gather(images, ids, mesh,
+                                               labels=labels)
+            else:
+                img = jax.lax.with_sharding_constraint(
+                    images[ids], batch_sharding)
+                lab = labels[ids]
+            batch = {"image": img, "label": lab, "mask": mask}
+            return step_fn(variables, batch)
+    else:
 
-            @jax.jit
-            def run(variables, images, ids, mask):
-                if sharded:
-                    img = sharded_pool_gather(images, ids, mesh)
-                else:
-                    img = jax.lax.with_sharding_constraint(
-                        images[ids], batch_sharding)
-                batch = {"image": img, "mask": mask}
-                return step_fn(variables, batch)
+        @jax.jit
+        def run(variables, images, ids, mask):
+            if sharded:
+                img = sharded_pool_gather(images, ids, mesh)
+            else:
+                img = jax.lax.with_sharding_constraint(
+                    images[ids], batch_sharding)
+            batch = {"image": img, "mask": mask}
+            return step_fn(variables, batch)
 
-        steps[key] = run
-    return steps[key]
+    # setdefault under the lock: if another thread built the same runner
+    # meanwhile, ONE wins and both callers share it — two live runner
+    # objects for one (step_fn, layout) would each compile separately.
+    with _CACHE_LOCK:
+        return steps.setdefault(key, run)

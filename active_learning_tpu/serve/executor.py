@@ -26,12 +26,15 @@ loop.  Design decisions, each load-bearing:
     serving throughput is bounded by max(host, PCIe, device), the same
     discipline as the offline pool scan's streaming fallback.
   * **Hot checkpoint reload between batches.**  The executor polls the
-    experiment's checkpoint directory (train/checkpoint.latest_best_ckpt)
-    at a bounded cadence and swaps in a newer round's ``best_rd_{n}``
-    between batches — a running AL experiment's freshest model is
-    served without restarting, and since checkpoint writes are atomic
-    (tmp + rename) a reload can never observe a torn file.  Variables
-    are replicated fresh and the old tree dropped; the jitted steps are
+    experiment's checkpoint directory at a bounded cadence and swaps in
+    a newer round's ``best_rd_{n}`` between batches — a running AL
+    experiment's freshest model is served without restarting.  The
+    probe is the SHARED ``train/checkpoint.BestCkptWatcher`` (the same
+    helper the pipelined round's speculative scorer uses): writes are
+    atomic (tmp + rename) so a reload can never observe a torn file,
+    and the monotonic (round, epoch) publish tag makes two publishes
+    within one mtime granule distinguishable.  Variables are replicated
+    fresh and the old tree dropped; the jitted steps are
     weight-agnostic, so a reload costs no recompile.
 """
 
@@ -93,7 +96,8 @@ class DeviceExecutor:
         self.logger = get_logger()
 
         self.served_round = -1
-        self._ckpt_stamp: Optional[Tuple[int, float]] = None
+        self._watcher = (ckpt_lib.BestCkptWatcher(ckpt_dir)
+                         if ckpt_dir is not None else None)
         if variables is None:
             if ckpt_dir is None:
                 raise ValueError("need variables or ckpt_dir")
@@ -117,20 +121,33 @@ class DeviceExecutor:
     # -- checkpoint (re)loading ------------------------------------------
 
     def _load_latest(self, required: bool = False):
-        path, rd = ckpt_lib.latest_best_ckpt(self.ckpt_dir)
-        if path is None:
-            if required:
+        polled = self._watcher.poll()
+        if polled is None and required and self.served_round < 0:
+            # The watcher also reports None for TRANSIENT conditions (a
+            # writer raced between its weight and tag renames, a file
+            # rotating away mid-read).  At startup, only "nothing on
+            # disk" is fatal; a present-but-racing checkpoint settles
+            # within a publish, so retry briefly before giving up.
+            path, _ = ckpt_lib.latest_best_ckpt(self.ckpt_dir)
+            if path is None:
                 raise FileNotFoundError(
                     f"no best_rd_*.msgpack under {self.ckpt_dir}")
+            for _ in range(50):
+                time.sleep(0.1)
+                polled = self._watcher.poll()
+                if polled is not None:
+                    break
+            else:
+                raise RuntimeError(
+                    f"best checkpoint under {self.ckpt_dir} never "
+                    "settled (weights/tag publish kept racing)")
+        if polled is None:
             return None
-        stamp = (rd, _mtime(path))
-        if stamp == self._ckpt_stamp:
-            return None
-        variables = ckpt_lib.load_variables(path)
-        self._ckpt_stamp = stamp
+        variables, rd, tag = polled
         self.served_round = rd
-        self.logger.info(f"serve: loaded best checkpoint of round {rd} "
-                         f"({path})")
+        self.logger.info(
+            f"serve: loaded best checkpoint of round {rd}"
+            + (f" (best epoch {tag[1]})" if tag else ""))
         return variables
 
     def maybe_reload(self, now: Optional[float] = None) -> bool:
@@ -284,9 +301,3 @@ def _reject(future, exc: Exception) -> None:
     loop = future.get_loop()
     loop.call_soon_threadsafe(
         lambda: future.set_exception(exc) if not future.done() else None)
-
-
-def _mtime(path: str) -> float:
-    import os
-
-    return os.path.getmtime(path)

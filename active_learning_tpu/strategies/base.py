@@ -92,6 +92,11 @@ class Strategy:
         # consume a mid-round fit state from disk; trainer.fit discards
         # stale states otherwise.
         self.resume_next_fit: bool = False
+        # The pipelined-round coordinator (experiment/pipeline.py), or
+        # None for the sequential loop.  The driver installs it; when
+        # present, collect_scores consumes speculative chunk scores and
+        # train() wires the best-ckpt publish into the fit.
+        self.pipeline = None
         self._score_steps: Dict[str, Callable] = {}
         # Per-experiment init key; split once per re-init so every round's
         # random re-initialization is fresh but reproducible.
@@ -182,6 +187,23 @@ class Strategy:
     def query(self, budget: int) -> Tuple[np.ndarray, int]:
         raise NotImplementedError
 
+    def speculative_scoring_plan(self) -> Optional[Dict]:
+        """The NEXT query's scoring pass as a plan the pipelined round's
+        speculative scorer can run ahead of time, or None when there is
+        nothing safely speculable.
+
+        Contract (experiment/pipeline.py): the plan must be computed
+        WITHOUT consuming any rng and must name EXACTLY the
+        (kind, keys, idxs) the coming ``query`` will hand to
+        ``collect_scores`` — the pipeline serves the speculative result
+        only on an exact match, so a wrong plan degrades to the
+        sequential pass, never to a wrong score.  Samplers whose scored
+        index order is rng-dependent (partitioned variants, subset
+        caps) or who score with non-checkpoint state (VAAL's VAE)
+        return None.  Keys: ``kind`` (a _get_score_step name), ``keys``
+        (tuple), ``idxs`` (int64 array)."""
+        return None
+
     def update(self, labeled_idxs, cur_cost: float) -> None:
         """Mark queried examples labeled, spend budget, emit the audit
         trail (strategy.py:459-485)."""
@@ -203,6 +225,11 @@ class Strategy:
             self.init_network_weights()
         labeled = self.already_labeled_idxs()
         self.logger.info(f"Starting training on round {self.round}")
+        if self.pipeline is not None:
+            # The select-time prefetch must never run INTO the fit it
+            # warmed — on the last round (which never arms) this is the
+            # only join.
+            self.pipeline.join_prefetch()
 
         def metric_cb(name: str, value: float, step: int) -> None:
             self.sink.log_metric(name, value, step=step)
@@ -220,8 +247,20 @@ class Strategy:
             weight_paths=self.weight_paths(),
             metric_cb=metric_cb,
             resume_fit_state=self.resume_next_fit,
+            # The in-process leg of the best-ckpt bus: the pipelined
+            # round's speculative scorer starts on a new best the moment
+            # it is snapshotted, without waiting for the periodic disk
+            # publish.
+            on_best=(self.pipeline.publish_best
+                     if self.pipeline is not None else None),
         )
         self.resume_next_fit = False
+        if self.pipeline is not None:
+            # Pin the FINAL (round, best_epoch) tag: speculative chunks
+            # scored from any other checkpoint are now dead, and the
+            # scorer keeps working from the final one through
+            # load_best_ckpt/test until the next query consumes it.
+            self.pipeline.finalize(self.round, result.best_epoch)
         self.state = result.state
         self.best_epoch = result.best_epoch
         # The fit's best validation accuracy: collapse detectors (e.g.
@@ -319,15 +358,44 @@ class Strategy:
         """Mesh-parallel scoring pass over ``al_set[idxs]`` returning host
         arrays aligned with ``idxs``.  With telemetry on, the pass's
         pool-scan rate lands in the sink as ``pool_rows_per_sec`` —
-        the acquisition-side counterpart of the trainer's imgs_per_sec."""
+        the acquisition-side counterpart of the trainer's imgs_per_sec.
+
+        Under a pipelined round the speculative scorer is consulted
+        first: chunks it pre-scored with the FINAL best checkpoint are
+        served as-is and the rest are completed inline — bit-identical
+        either way (experiment/pipeline.py's correctness contract), so
+        speculation only ever changes wall-clock."""
         from ..telemetry import runtime as tele_runtime
+        bs = self._score_batch_size()
+        if self.pipeline is not None:
+            out = self.pipeline.consume(kind, keys, np.asarray(idxs), bs,
+                                        self.state.variables)
+            if out is not None:
+                if tele_runtime.get_run().train_metrics:
+                    self.sink.log_metric(
+                        "spec_hit_frac",
+                        self.pipeline.last_consume.get("hit_frac", 0.0),
+                        step=self.round)
+                    # The same scan-rate metric the sequential pass
+                    # emits, over the scoring COMPUTE the hand-over
+                    # actually cost (served chunks' scorer walls +
+                    # inline completions) — most of it hidden in the
+                    # fit, but the rate stays comparable across modes.
+                    score_s = self.pipeline.last_consume.get("score_s", 0)
+                    if score_s > 0:
+                        self.sink.log_metric(
+                            "pool_rows_per_sec",
+                            round(len(idxs) / score_s, 1),
+                            step=self.round)
+                return out
         loader = self.train_cfg.loader_te
         t0 = time.perf_counter()
         out = scoring.collect_pool(
-            self.al_set, idxs, self._score_batch_size(),
+            self.al_set, idxs, bs,
             self._get_score_step(kind), self.state.variables, self.mesh,
             num_workers=loader.num_workers, prefetch=loader.prefetch,
-            keys=keys, **self._resident_kwargs())
+            keys=keys, dispatch_lock=self.trainer.dispatch_lock,
+            **self._resident_kwargs())
         dt = time.perf_counter() - t0
         if tele_runtime.get_run().train_metrics and dt > 0:
             self.sink.log_metric("pool_rows_per_sec",

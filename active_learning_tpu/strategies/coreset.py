@@ -101,6 +101,36 @@ class CoresetSampler(Strategy):
             self._saved_factors = factors
         return factors
 
+    # -- speculative plan (the pipelined round) ---------------------------
+
+    # The scoring pass collect_scores will run: fixed per subclass so
+    # the speculative plan and query can never disagree on the
+    # statistic.
+    spec_kind = "embed"
+    spec_keys = ("embedding",)
+
+    def speculative_scoring_plan(self):
+        """The coming query's embedding pass, rng-free: with the subset
+        caps off, ``idxs_for_coreset`` is the SORTED union of available
+        and labeled indices — a pure function of the pool masks — even
+        though query() builds it from two rng-shuffled views.  With a
+        cap on, the subset IS an rng draw, so the round runs
+        un-speculated; same when the frozen-feature factor cache already
+        holds the answer (nothing will be scored at all)."""
+        if (self.cfg.subset_labeled is not None
+                or self.cfg.subset_unlabeled is not None):
+            return None
+        if (self.cache_factors and self.cfg.freeze_feature
+                and self._saved_factors is not None):
+            return None
+        available = self.pool.available_query_idxs(shuffle=False)
+        if len(available) == 0:
+            return None
+        idxs = np.sort(np.concatenate(
+            [available, self.pool.labeled_idxs()])).astype(np.int64)
+        return {"kind": self.spec_kind, "keys": self.spec_keys,
+                "idxs": idxs}
+
     # -- query ------------------------------------------------------------
 
     def query(self, budget: int) -> Tuple[np.ndarray, int]:
@@ -131,6 +161,8 @@ class BADGESampler(CoresetSampler):
 
     randomize = True
     cache_factors = False
+    spec_kind = "badge"
+    spec_keys = ("grad_a", "grad_e")
 
     def get_factors(self, idxs: np.ndarray) -> Factors:
         out = self.collect_scores(idxs, "badge", keys=("grad_a", "grad_e"))
@@ -143,6 +175,12 @@ class PartitionedCoresetSampler(CoresetSampler):
     into ``partitions`` equal shards (so every shard sees the same
     labeled/unlabeled balance), run k-center per shard with a proportional
     budget share (partitioned_coreset_sampler.py:36-84)."""
+
+    def speculative_scoring_plan(self):
+        """Partitions are rng draws (generate_partition_idxs_list
+        shuffles with the experiment rng), so the per-partition scoring
+        order cannot be known ahead of the query — no speculation."""
+        return None
 
     def generate_partition_idxs_list(self, input_idxs: np.ndarray):
         idxs = np.array(input_idxs)

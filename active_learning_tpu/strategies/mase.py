@@ -25,6 +25,15 @@ class MASESampler(Strategy):
     """Examples closest to ANY decision boundary first
     (mase_sampler.py:20-28)."""
 
+    def speculative_scoring_plan(self):
+        """Both MASE and BASE score the UNSHUFFLED available set (no
+        rng), so the pipelined round pre-scores it; keys None = every
+        output of the mase step (query reads margin, radii, AND pred)."""
+        idxs = self.pool.available_query_idxs(shuffle=False)
+        if len(idxs) == 0:
+            return None
+        return {"kind": "mase", "keys": None, "idxs": idxs}
+
     def compute_margins(self, idxs: np.ndarray):
         """(min_margins, per_class_radii, pred_labels) for ``idxs``
         (mase_sampler.py:30-96, vectorized + sharded)."""

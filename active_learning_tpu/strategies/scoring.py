@@ -21,7 +21,7 @@ scores.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Iterable, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -284,6 +284,66 @@ from ..config import RESIDENT_SCORING_BYTES_DEFAULT as RESIDENT_MAX_BYTES
 from ..parallel import resident as resident_lib
 
 
+# -- chunk-resumable scoring (the pipelined round) --------------------------
+#
+# A scoring pass over (idxs, batch_size) is a SEQUENCE of fixed-shape
+# batches, and each jitted step call is independent of its neighbors, so
+# the pass can be cut at any batch boundary and resumed — or computed
+# out of order, on another thread, from a different-but-equal variables
+# tree — without changing a single output bit: collect_pool(idxs[sl])
+# over a batch-aligned row slice produces exactly the batches sl covers
+# of the monolithic collect_pool(idxs) call (same rows per batch, same
+# tail padding, same jitted executable).  The speculative scorer of the
+# pipelined round (experiment/pipeline.py) leans on this: it pre-scores
+# chunk slices while training still runs, and any chunk invalidated by
+# a later best checkpoint is recomputed inline at query time; splicing
+# the chunks back together is bit-identical to the sequential pass
+# (pinned in tests/test_pipeline.py).
+
+def chunk_row_slices(n_rows: int, batch_size: int,
+                     chunk_batches: int) -> List[slice]:
+    """Row slices covering ``chunk_batches`` whole batches each (the last
+    takes the remainder) — the chunk plan both the speculative scorer
+    and the inline-completion path iterate, so the two can never
+    disagree on chunk boundaries."""
+    from ..data.pipeline import num_batches
+    if n_rows <= 0:
+        return []
+    n_b = num_batches(n_rows, batch_size)
+    step = max(1, int(chunk_batches))
+    return [slice(b0 * batch_size, min((b0 + step) * batch_size, n_rows))
+            for b0 in range(0, n_b, step)]
+
+
+def splice_chunks(chunks: List[Dict[str, np.ndarray]]
+                  ) -> Dict[str, np.ndarray]:
+    """Concatenate per-chunk host outputs (in chunk order) back into one
+    idxs-aligned dict — the inverse of scoring each chunk_row_slices
+    entry separately."""
+    if len(chunks) == 1:
+        return chunks[0]
+    return {k: np.concatenate([c[k] for c in chunks], axis=0)
+            for k in chunks[0]}
+
+
+class _NullGate:
+    """The no-lock stand-in for mesh_lib.DispatchGate when collect_pool
+    runs single-threaded (every caller outside the pipelined round):
+    context enter/exit and drain are all no-ops."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def drain(self, tree):
+        return tree
+
+
+_NULL_GATE = _NullGate()
+
+
 def _finalize(chunks: Dict[str, list], multi: bool, mesh, n: int
               ) -> Dict[str, np.ndarray]:
     if multi:
@@ -307,6 +367,7 @@ def collect_pool(
     resident_max_bytes: int = RESIDENT_MAX_BYTES,
     host_s2d: bool = False,
     pool_sharding: str = "replicated",
+    dispatch_lock: Optional[Any] = None,
 ) -> Dict[str, np.ndarray]:
     """Run ``step_fn`` over ``dataset[idxs]`` in fixed-shape sharded batches
     and return host arrays of length ``len(idxs)``, row i scoring pool index
@@ -321,8 +382,20 @@ def collect_pool(
 
     ``idxs`` must be non-empty (samplers guard the exhausted-pool case
     before scoring).
+
+    ``dispatch_lock``: a mesh_lib.DispatchGate held around every jitted
+    dispatch (never around a host fetch).  The pipelined round's
+    speculative scorer and the trainer share one gate
+    (Trainer.dispatch_lock) so two threads' collective-bearing
+    computations always enqueue in ONE global order on every device —
+    and on CPU meshes the gate's drain_mode additionally completes each
+    computation before release (XLA:CPU reorders execution behind the
+    enqueue order; see DispatchGate).  None (every single-threaded
+    caller) costs nothing.
     """
     idxs = np.asarray(idxs)
+    if dispatch_lock is None:
+        dispatch_lock = _NULL_GATE
     n = len(idxs)
     if n == 0:
         raise ValueError("collect_pool called with empty idxs; guard the "
@@ -379,8 +452,11 @@ def collect_pool(
         t_chunk, chunk_first = t_pool0, 0
         for i, b in enumerate(batch_index_lists(idxs, batch_size)):
             ids, mask = padded_batch_layout(b, batch_size)
-            small = mesh_lib.replicate((ids.astype(np.int32), mask), mesh)
-            out = run(variables, images_dev, *small)
+            with dispatch_lock:
+                small = mesh_lib.replicate((ids.astype(np.int32), mask),
+                                           mesh)
+                out = run(variables, images_dev, *small)
+                dispatch_lock.drain(out)
             if keys is not None:
                 out = {k: out[k] for k in keys}
             for k, v in out.items():
@@ -447,7 +523,9 @@ def collect_pool(
     for i, sharded in enumerate(device_prefetch(
             checked_host_batches(),
             lambda b: mesh_lib.shard_batch(b, mesh))):
-        out = step_fn(variables, sharded)
+        with dispatch_lock:
+            out = step_fn(variables, sharded)
+            dispatch_lock.drain(out)
         if keys is not None:
             out = {k: out[k] for k in keys}
         for k, v in out.items():

@@ -22,6 +22,17 @@ class _ScoreAscendingSampler(Strategy):
 
     score_key: str = ""
 
+    def speculative_scoring_plan(self):
+        """The coming query scores the UNSHUFFLED available set — a pure
+        function of the pool masks, no rng anywhere — so the pipelined
+        round can pre-score it chunk by chunk during the fit's patience
+        tail (experiment/pipeline.py)."""
+        idxs = self.pool.available_query_idxs(shuffle=False)
+        if len(idxs) == 0:
+            return None
+        return {"kind": "prob_stats", "keys": (self.score_key,),
+                "idxs": idxs}
+
     def query(self, budget: int) -> Tuple[np.ndarray, int]:
         idxs = self.available_query_idxs(shuffle=False)
         if len(idxs) == 0:

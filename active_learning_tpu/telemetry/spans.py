@@ -67,6 +67,7 @@ class SpanTracer:
         self._origin = time.perf_counter()
         self._wall_origin = time.time()
         self._local = threading.local()
+        self._thread_names: Dict[int, str] = {}
 
     # -- span stack (per thread, for nesting introspection) ---------------
 
@@ -112,6 +113,30 @@ class SpanTracer:
         sp = Span(name, args)
         sp.t0, sp.t1 = t0, t1
         self._record(sp)
+
+    def name_thread(self, name: str) -> None:
+        """Label the CURRENT thread's track in the exported trace (a
+        Chrome ``thread_name`` metadata event).  The pipelined round's
+        executor threads (spec-scorer, feed-prefetch) call this once at
+        start so their spans render as NAMED side-by-side tracks in
+        Perfetto next to the main thread's — every thread already gets
+        its own ``tid`` (Span stamps ``threading.get_ident()``), which is
+        what keeps concurrent spans from corrupting each other's nesting;
+        this adds the human-readable label.  Idempotent per (thread,
+        name); metadata events don't count against the buffer cap (a
+        handful per run, and dropping one would orphan a whole track's
+        spans from their label)."""
+        if not self.enabled:
+            return
+        tid = threading.get_ident() % 2**31
+        with self._lock:
+            if self._thread_names.get(tid) == name:
+                return
+            self._thread_names[tid] = name
+            self.events.append({
+                "name": "thread_name", "ph": "M", "pid": os.getpid(),
+                "tid": tid, "args": {"name": name},
+            })
 
     def instant(self, name: str, args: Optional[Dict[str, Any]] = None
                 ) -> None:

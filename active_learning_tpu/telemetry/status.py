@@ -111,8 +111,8 @@ def summarize(log_dir: str, stale_after: Optional[float] = None,
             "deadline_s": deadline,
             "stale": stale,
             **{k: hb.get(k) for k in ("status", "round", "phase", "epoch",
-                                      "step", "process_index", "pid",
-                                      "progress")},
+                                      "step", "spec_phase", "spec_chunk",
+                                      "process_index", "pid", "progress")},
         })
     events = read_metrics_tail(log_dir)
     metrics = _latest_metrics(events, [
@@ -121,6 +121,7 @@ def summarize(log_dir: str, stale_after: Optional[float] = None,
         "train_loss_ema", "grad_norm_ema", "hbm_peak_gb",
         "jit_cache_miss_delta", "stall_suspected",
         "rd_query_time", "rd_train_time", "rd_test_time",
+        "overlap_frac", "round_vs_max_phase", "spec_hit_frac",
     ])
     state = ("no-heartbeat" if not heartbeats
              else "stale" if any_stale else "ok")
@@ -133,9 +134,14 @@ def render_text(summary: Dict[str, Any]) -> str:
              f"({summary['log_dir']})"]
     for hb in summary["heartbeats"]:
         flag = "STALE" if hb["stale"] else (hb.get("status") or "running")
+        # The pipelined round runs TWO phases at once (DESIGN.md §8): the
+        # main thread's train/test phase and the speculative scorer's.
+        # Both render; an idle scorer is omitted rather than printed.
+        keys = ["round", "phase", "epoch", "step"]
+        if hb.get("spec_phase") not in (None, "idle"):
+            keys += ["spec_phase", "spec_chunk"]
         where = " ".join(
-            f"{k}={hb[k]}" for k in ("round", "phase", "epoch", "step")
-            if hb.get(k) is not None)
+            f"{k}={hb[k]}" for k in keys if hb.get(k) is not None)
         age = f"{hb['age_s']}s ago" if hb["age_s"] is not None else "?"
         proc = (f"p{hb['process_index']}"
                 if hb.get("process_index") is not None else "p0")
@@ -151,7 +157,9 @@ def render_text(summary: Dict[str, Any]) -> str:
                      "step_time_ms_p99", "pool_rows_per_sec",
                      "train_loss_ema", "grad_norm_ema", "hbm_peak_gb",
                      "jit_cache_miss_delta", "stall_suspected",
-                     "rd_query_time", "rd_train_time", "rd_test_time"):
+                     "rd_query_time", "rd_train_time", "rd_test_time",
+                     "overlap_frac", "round_vs_max_phase",
+                     "spec_hit_frac"):
             if name in m:
                 e = m[name]
                 step = f" @step {e['step']}" if e.get("step") is not None \

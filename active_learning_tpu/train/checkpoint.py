@@ -98,6 +98,125 @@ def latest_best_ckpt(ckpt_dir: str) -> Tuple[Optional[str], int]:
 _BEST_CKPT_RE = re.compile(r"^best_rd_(\d+)\.msgpack$")
 
 
+# -- best-ckpt publish/subscribe --------------------------------------------
+#
+# The best checkpoint has CONCURRENT READERS now: the serve executor's
+# hot reload (between batches) and the speculative scorer of the
+# pipelined round (experiment/pipeline.py) both load ``best_rd_{n}``
+# while the trainer is still writing newer ones.  Atomic tmp+rename
+# (save_variables) already guarantees no reader sees a torn FILE; what
+# it cannot guarantee is freshness attribution — two publishes inside
+# one mtime granule look identical to an mtime-stamped poller, and a
+# reader that pairs new weights with a stale version guess would score
+# pool chunks it later trusts as current.  So every best-ckpt publish
+# also writes a TAG sidecar (``best_rd_{n}.msgpack.tag.json``, atomic)
+# carrying the monotonic (round, epoch) the weights were best at:
+# within one round the best epoch only ever increases, so the tag is a
+# strictly monotonic version — never reused, never clock-dependent.
+# Write order is weights THEN tag; BestCkptWatcher re-reads the tag
+# after loading and treats any disagreement as not-ready (retry next
+# poll), so a poll result's (variables, tag) pairing is always either
+# exact or attributed to an OLDER tag than the weights — which the
+# pipeline's invalidation rule (anything not the final best is
+# recomputed) turns into wasted work, never a wrong score.
+
+def publish_best(path: str, variables: Dict[str, Any], *, round_idx: int,
+                 epoch: int) -> None:
+    """Atomically publish a best checkpoint plus its monotonic
+    (round, epoch) tag — the writer side of the best-ckpt bus."""
+    save_variables(path, variables)
+    tag = {"round": int(round_idx), "epoch": int(epoch)}
+    tmp = f"{path}.tag.json.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(tag, fh)
+    os.replace(tmp, f"{path}.tag.json")
+
+
+def read_best_tag(path: str) -> Optional[Tuple[int, int]]:
+    """The (round, epoch) tag published alongside ``path``; None when the
+    sidecar is absent (a pre-tag writer) or unreadable."""
+    try:
+        with open(f"{path}.tag.json") as fh:
+            tag = json.load(fh)
+        return (int(tag["round"]), int(tag["epoch"]))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+class BestCkptWatcher:
+    """Shared hot-reload probe over an experiment's checkpoint directory
+    — ONE spelling of "give me the newest fully-published best ckpt"
+    for every concurrent reader (the serve executor between batches,
+    the speculative scorer of the pipelined round).
+
+    ``poll()`` returns ``(variables, round, tag)`` when a best ckpt
+    NEWER than the last successful poll is completely published, else
+    None.  Newness is judged by the monotonic (round, epoch) tag when
+    one exists and falls back to (round, mtime) for pre-tag writers; the
+    tag is re-read after the weight load and any disagreement reads as
+    not-ready (the writer raced between the two renames — the next poll
+    sees the settled pair).  A torn or half-written file is impossible
+    by construction (every rename is atomic)."""
+
+    def __init__(self, ckpt_dir: str):
+        self.ckpt_dir = ckpt_dir
+        self._stamp: Optional[Tuple] = None
+
+    @staticmethod
+    def _stamp_of(rd: int, tag, mtime: float) -> Tuple:
+        # The tag orders publishes exactly; mtime rides along only for
+        # tag-less (legacy) writers, where it is the best available.  A
+        # tagged publish always supersedes an untagged one at the same
+        # round (the tagged writer is the newer code), and the tuple
+        # shape keeps every stamp comparable.
+        return ((rd, 0, (-1, -1), mtime) if tag is None
+                else (rd, 1, tag, 0.0))
+
+    def prime(self) -> None:
+        """Mark the CURRENT newest publish as already-seen WITHOUT
+        loading it.  A subscriber that only cares about future
+        publishes (the speculative scorer arming at round start, when
+        the newest file on disk is the previous round's best) would
+        otherwise deserialize a full checkpoint on its first poll just
+        to discard it by round."""
+        path, rd = latest_best_ckpt(self.ckpt_dir)
+        if path is None:
+            return
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return
+        stamp = self._stamp_of(rd, read_best_tag(path), mtime)
+        if self._stamp is None or stamp > self._stamp:
+            self._stamp = stamp
+
+    def poll(self):
+        path, rd = latest_best_ckpt(self.ckpt_dir)
+        if path is None:
+            return None
+        tag = read_best_tag(path)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return None
+        stamp = self._stamp_of(rd, tag, mtime)
+        if self._stamp is not None and stamp <= self._stamp:
+            return None
+        try:
+            variables = load_variables(path)
+        except (OSError, ValueError):
+            # The file rotated away mid-read (a newer round replaced
+            # it); the next poll sees the settled state.
+            return None
+        if read_best_tag(path) != tag:
+            # Writer raced between the weight rename and the tag rename:
+            # the pairing cannot be proven, so report nothing and let the
+            # next poll observe the completed publish.
+            return None
+        self._stamp = stamp
+        return variables, rd, tag
+
+
 # -- mid-round fit state ----------------------------------------------------
 #
 # Everything needed to continue an interrupted Trainer.fit from the last

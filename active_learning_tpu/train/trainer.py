@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -147,6 +148,19 @@ class Trainer:
         # round-boundary telemetry (driver gauges) and bench attribution
         # read it; {"source": None} until a fit has run.
         self.last_feed: Dict[str, Any] = {"source": None}
+        # ONE enqueue order for collective-bearing dispatches: the
+        # pipelined round's speculative scorer dispatches pool chunks
+        # from its own thread while fit/evaluate dispatch train and
+        # validation steps here — two threads interleaving collective
+        # computations with per-device reordering is how a mesh
+        # deadlocks.  Every jitted dispatch below (and collect_pool's,
+        # via Strategy/pipeline passing this gate) holds it around the
+        # enqueue; on CPU meshes the pipelined round additionally flips
+        # the gate's drain_mode so each computation COMPLETES before the
+        # gate releases (XLA:CPU does not preserve enqueue order at
+        # execution — mesh_lib.DispatchGate).  Sequential paths see an
+        # uncontended lock and a no-op drain: nanoseconds.
+        self.dispatch_lock = mesh_lib.DispatchGate()
 
     def refresh_resident_budget(self) -> int:
         """Re-size the AUTO resident budget from current HBM headroom
@@ -513,6 +527,70 @@ class Trainer:
                 return "resident_copy"
         return host
 
+    def _ensure_exec_form(self, feed: str) -> bool:
+        """ONE rule for which jitted execution form a resident-feed fit
+        uses — shared by fit and the select-time prefetch
+        (prepare_next_fit), so the prefetch can never warm a form the
+        fit won't pick.  Lazily builds + registers the chosen form and
+        returns ``use_scan``: one scan dispatch per epoch on
+        accelerators (and when the scan is explicitly forced), one
+        jitted gather+step dispatch per batch on CPU meshes — XLA:CPU
+        runs conv bodies inside lax.scan several times slower than
+        directly-dispatched ops (_build_resident_batch_step), and the
+        per-batch form also skips the step-bucket padding entirely."""
+        scan_form = (self.mesh.devices.flat[0].platform != "cpu"
+                     or self.cfg.device_resident is True)
+        use_scan = (feed == "resident_copy"
+                    or (feed == "resident" and scan_form))
+        if use_scan and self._epoch_scan is None:
+            self._epoch_scan = self._build_epoch_scan()
+            tele_runtime.get_run().register_jit(
+                f"epoch_scan@{id(self):x}", self._epoch_scan)
+        if (feed == "resident" and not use_scan
+                and self._resident_batch_step is None):
+            self._resident_batch_step = self._build_resident_batch_step()
+            tele_runtime.get_run().register_jit(
+                f"resident_batch_step@{id(self):x}",
+                self._resident_batch_step)
+        return use_scan
+
+    def prepare_next_fit(self, train_set: Dataset, labeled_now: np.ndarray,
+                         expected_labeled: int) -> Optional[str]:
+        """Select-time train prefetch (the pipelined round, DESIGN.md
+        §8): while k-center/BADGE selection runs its collective scans on
+        the main thread, pre-resolve the feed the COMING fit will take
+        — sized at the post-selection labeled count, which is known
+        before the picks are — and warm what it touches, so ``fit``
+        starts with zero feed stall at step 0:
+
+          * resident-gather: ensure the shared pool is pinned (an upload
+            here is one the fit no longer pays) and pre-build the jitted
+            execution form the fit will pick, so its first step is a
+            cache lookup;
+          * host feeds: warm the gather/decode path (memmap cache, page
+            cache) over the rows ALREADY labeled — the new picks don't
+            exist until selection returns, but they are ``round_budget``
+            of ``expected_labeled`` rows; the rest re-decode warm.
+
+        rng-free and state-free by contract: everything here is work the
+        fit would do anyway, done early — pipelined and sequential
+        rounds stay bit-identical.  Returns the resolved feed (None on
+        failure; prefetch is best-effort)."""
+        expected = np.arange(max(0, int(expected_labeled)), dtype=np.int64)
+        feed = self.resolve_train_feed(train_set, expected, None)
+        if feed == "resident":
+            self._resident_feed_arrays(train_set)
+        if feed in ("resident", "resident_copy"):
+            # The SAME form rule + lazy build the fit runs — shared so
+            # the prefetch can never warm a form the fit won't use.
+            self._ensure_exec_form(feed)
+        elif len(labeled_now):
+            # Bounded warm-up of the host gather/decode path; the rows
+            # land in the memmap/page cache and are dropped here.
+            cap = min(len(labeled_now), 4096)
+            train_set.gather(np.asarray(labeled_now[:cap], dtype=np.int64))
+        return feed
+
     def _feed_workers(self) -> int:
         """Gather/decode worker threads for the host train feed:
         TrainConfig.feed_workers, deferring to the train loader's
@@ -697,11 +775,13 @@ class Trainer:
             totals = None
             for b in batch_index_lists(np.asarray(idxs), bs):
                 ids, mask = padded_batch_layout(b, bs)
-                small = mesh_lib.replicate((ids.astype(np.int32), mask),
-                                           self.mesh)
-                counts = run(variables, images_dev, labels_dev, *small)
-                totals = (counts if totals is None
-                          else jax.tree.map(jnp.add, totals, counts))
+                with self.dispatch_lock:
+                    small = mesh_lib.replicate((ids.astype(np.int32), mask),
+                                               self.mesh)
+                    counts = run(variables, images_dev, labels_dev, *small)
+                    totals = (counts if totals is None
+                              else jax.tree.map(jnp.add, totals, counts))
+                    self.dispatch_lock.drain(totals)
             return accumulate_metrics(iter(() if totals is None
                                            else (totals,)))
 
@@ -713,8 +793,14 @@ class Trainer:
                     num_threads=self.cfg.loader_te.num_workers,
                     prefetch=self.cfg.loader_te.prefetch, local=local,
                     s2d=self._host_s2d):
-                yield eval_step(variables,
-                                mesh_lib.shard_batch(batch, self.mesh))
+                # Dispatch under the lock, yield outside it: the lock
+                # orders enqueues only and must never be held across the
+                # consumer's (possibly fetching) work.
+                with self.dispatch_lock:
+                    out = eval_step(variables,
+                                    mesh_lib.shard_batch(batch, self.mesh))
+                    self.dispatch_lock.drain(out)
+                yield out
 
         return accumulate_metrics(counts())
 
@@ -736,6 +822,8 @@ class Trainer:
         batch_hook: Optional[Callable[[int, Dict[str, np.ndarray]], None]]
         = None,
         resume_fit_state: bool = True,
+        on_best: Optional[Callable[[int, int, Dict[str, Any]], None]]
+        = None,
     ) -> FitResult:
         """Train on the labeled subset with per-epoch validation + early
         stopping (parallel_train_fn, strategy.py:304-381).
@@ -747,7 +835,15 @@ class Trainer:
         ``batch_hook(epoch, host_batch)`` runs after each classifier step —
         the seam that lets VAAL co-train its VAE/discriminator inside the
         same epoch loop (the reference overrides the whole
-        parallel_train_fn, vaal_sampler.py:77-183)."""
+        parallel_train_fn, vaal_sampler.py:77-183).
+
+        ``on_best(round_idx, epoch, variables)`` fires whenever a new
+        best-validation snapshot is taken — the in-process publish leg
+        of the best-ckpt bus (the pipelined round's speculative scorer
+        subscribes; experiment/pipeline.py).  The variables tree is the
+        fresh device-side copy, never donated afterwards, so the
+        subscriber may keep using it.  A failing callback is logged and
+        ignored: speculation must never take a fit down."""
         use_es = es_patience != 0 and len(eval_idxs) > 0
         from ..data.cache import CachedEvalRows, DecodedPoolCache
         if (use_es and self.cfg.cache_eval and hasattr(al_set, "paths")
@@ -773,16 +869,7 @@ class Trainer:
         # SAME pinned pool scoring/evaluation use (zero host image
         # copies), "resident_copy" from a private labeled-subset upload.
         feed = self.resolve_train_feed(train_set, labeled_idxs, batch_hook)
-        # Execution form for the resident feed: one scan dispatch per
-        # epoch on accelerators (and when the scan is explicitly forced),
-        # one jitted gather+step dispatch per batch on CPU meshes —
-        # XLA:CPU runs conv bodies inside lax.scan several times slower
-        # than directly-dispatched ops (_build_resident_batch_step), and
-        # the per-batch form also skips the step-bucket padding entirely.
-        scan_form = (self.mesh.devices.flat[0].platform != "cpu"
-                     or self.cfg.device_resident is True)
-        use_scan = (feed == "resident_copy"
-                    or (feed == "resident" and scan_form))
+        use_scan = self._ensure_exec_form(feed)
         self.last_feed = {"source": feed, "feed_stall_frac": None,
                           "host_wait_ms_p50": None,
                           "form": ("scan" if use_scan else
@@ -805,17 +892,6 @@ class Trainer:
             # little and cost a layout axis on the step bucketing).
             dr_images, dr_labels = self._device_resident_arrays(
                 train_set, labeled_idxs, bs)
-        if use_scan and self._epoch_scan is None:
-            self._epoch_scan = self._build_epoch_scan()
-            tele_runtime.get_run().register_jit(
-                f"epoch_scan@{id(self):x}", self._epoch_scan)
-        if (feed == "resident" and not use_scan
-                and self._resident_batch_step is None):
-            self._resident_batch_step = self._build_resident_batch_step()
-            tele_runtime.get_run().register_jit(
-                f"resident_batch_step@{id(self):x}",
-                self._resident_batch_step)
-
         best_perf, best_epoch, es_count = 0.0, 0, 0
         best_variables = None  # device tree after an improvement this fit
         best_dirty = False  # True = best_variables newer than best_ckpt
@@ -929,11 +1005,13 @@ class Trainer:
                     # path commits, re-expressed as global pool rows —
                     # index math only, never an image byte.
                     idx_mat = feed_map[idx_mat]
-                state, key, losses, gnorms = self._epoch_scan(
-                    state, dr_images, dr_labels, jnp.asarray(idx_mat),
-                    jnp.asarray(mask_mat), jnp.asarray(valid), key, lr,
-                    class_weights, view=train_set.view,
-                    sharded=dr_sharded)
+                with self.dispatch_lock:
+                    state, key, losses, gnorms = self._epoch_scan(
+                        state, dr_images, dr_labels, jnp.asarray(idx_mat),
+                        jnp.asarray(mask_mat), jnp.asarray(valid), key, lr,
+                        class_weights, view=train_set.view,
+                        sharded=dr_sharded)
+                    self.dispatch_lock.drain(losses)
                 epoch_loss = jnp.sum(losses) / steps_real
                 epoch_gnorm = jnp.sum(gnorms) / steps_real
                 steps_run = steps_real
@@ -948,12 +1026,15 @@ class Trainer:
                 for b in batch_index_lists(labeled_idxs, bs,
                                            shuffle=True, rng=rng):
                     ids, mask = padded_batch_layout(b, bs)
-                    small = mesh_lib.replicate(
-                        (ids.astype(np.int32), mask), self.mesh)
-                    state, key, loss, gnorm = self._resident_batch_step(
-                        state, dr_images, dr_labels, *small, key, lr,
-                        class_weights, view=train_set.view,
-                        sharded=dr_sharded)
+                    with self.dispatch_lock:
+                        small = mesh_lib.replicate(
+                            (ids.astype(np.int32), mask), self.mesh)
+                        state, key, loss, gnorm = \
+                            self._resident_batch_step(
+                                state, dr_images, dr_labels, *small, key,
+                                lr, class_weights, view=train_set.view,
+                                sharded=dr_sharded)
+                        self.dispatch_lock.drain(loss)
                     losses.append(loss)
                     gnorms.append(gnorm)
                     if collect:
@@ -996,11 +1077,14 @@ class Trainer:
                         # serial leg, queue wait on the prefetched one):
                         # the numerator of feed_stall_frac.
                         host_waits.append(time.perf_counter() - t_wait)
-                    sharded = (item if put is not None
-                               else mesh_lib.shard_batch(item, self.mesh))
-                    state, key, loss, gnorm = self._chained_train_step(
-                        state, sharded, key, lr, class_weights,
-                        view=train_set.view)
+                    with self.dispatch_lock:
+                        sharded = (item if put is not None
+                                   else mesh_lib.shard_batch(item,
+                                                             self.mesh))
+                        state, key, loss, gnorm = self._chained_train_step(
+                            state, sharded, key, lr, class_weights,
+                            view=train_set.view)
+                        self.dispatch_lock.drain(loss)
                     losses.append(loss)
                     gnorms.append(gnorm)
                     if batch_hook is not None:
@@ -1061,6 +1145,12 @@ class Trainer:
                     best_variables = jax.tree.map(jnp.copy,
                                                   state.variables)
                     best_dirty = True
+                    if on_best is not None:
+                        try:
+                            on_best(round_idx, epoch, best_variables)
+                        except Exception:  # noqa: BLE001 - best-effort bus
+                            self.logger.exception(
+                                "on_best subscriber failed; continuing fit")
                 else:
                     es_count += 1
                 # The reference writes the latest ckpt every epoch
@@ -1073,9 +1163,13 @@ class Trainer:
                         # Rank-0-style write guard (strategy.py:425-430);
                         # on a pod the ckpt_path must be a shared
                         # filesystem so every process can read it back.
-                        ckpt_lib.save_variables(
+                        # publish_best = atomic write + monotonic
+                        # (round, best_epoch) tag for the concurrent
+                        # readers (serve hot-reload, speculative scorer).
+                        ckpt_lib.publish_best(
                             weight_paths["best_ckpt"],
-                            jax.tree.map(np.asarray, best_variables))
+                            jax.tree.map(np.asarray, best_variables),
+                            round_idx=round_idx, epoch=best_epoch)
                         best_dirty = False
                     ckpt_lib.save_variables(weight_paths["current_ckpt"],
                                             jax.tree.map(np.asarray,
@@ -1117,9 +1211,9 @@ class Trainer:
             best_variables = jax.tree.map(np.asarray, state.variables)
             best_dirty = True
         if best_dirty and weight_paths and mesh_lib.is_coordinator():
-            ckpt_lib.save_variables(weight_paths["best_ckpt"],
-                                    jax.tree.map(np.asarray,
-                                                 best_variables))
+            ckpt_lib.publish_best(weight_paths["best_ckpt"],
+                                  jax.tree.map(np.asarray, best_variables),
+                                  round_idx=round_idx, epoch=best_epoch)
         if weight_paths and mesh_lib.is_coordinator():
             ckpt_lib.save_variables(weight_paths["current_ckpt"],
                                     jax.tree.map(np.asarray,

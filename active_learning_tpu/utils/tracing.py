@@ -37,13 +37,16 @@ def phase_timer(name: str, round_idx: int, sink=None,
     """Wall-clock a phase, log it, and emit ``rd_{name}`` to the metrics
     sink — the reference's per-phase prints (main_al.py:160-178) with the
     profiler annotation added.  The timing IS the host span's: metric,
-    log, trace event, and heartbeat all read one measurement."""
+    log, trace event, and heartbeat all read one measurement.  Yields
+    the span so callers can read the same ``duration_s`` afterwards (the
+    driver's overlap_frac accounting sums phase walls from it — still
+    one measurement, never a second clock)."""
     logger = logger or get_logger()
     _tele_runtime.get_run().tick(force=True, phase=name, round=round_idx)
     with _tele_spans.get_tracer().span(
             name, args={"round": round_idx}) as sp:
         with annotate(f"{name}/rd{round_idx}"):
-            yield
+            yield sp
     seconds = sp.duration_s
     logger.info(f"Rd {round_idx} {name} is {seconds:.3f}s")
     if sink is not None:

@@ -37,6 +37,20 @@ DESIGN.md §2b):
      calls) — a 10.5 GB factor matrix pulled whole onto one host or
      chip is exactly the ceiling the sharded backend exists to break.
 
+... and the pipelined round's NEVER-SYNC-THE-TRAIN-STREAM invariant
+(speculative scoring, DESIGN.md §8):
+
+  7. ``experiment/pipeline.py`` must define every function in
+     ``PIPELINE_COORDINATOR_FNS``, and none of them may call
+     ``block_until_ready`` or ``device_get`` — the speculative scorer
+     overlaps the fit's patience tail, and a coordinator-level device
+     sync would serialize the very streams the module exists to
+     overlap.  (The scorer may wait on its OWN chunk outputs inside
+     collect_pool's host fetch — that blocks only its thread — and the
+     DispatchGate's CPU-only execution drain lives in parallel/mesh.py,
+     deliberately outside the lint's reach: it is the backend
+     workaround, not the coordinator.)
+
 Stdlib only; exits 0 clean / 1 with findings on stderr.
 """
 
@@ -82,6 +96,14 @@ SHARDED_DEVICE_FNS = ("_build_sharded_fns",)
 SHARDED_ORCHESTRATOR_FNS = ("_kcenter_greedy_sharded",)
 _SHARDED_HOST_CALLS = {"device_get", "asarray"}
 _SHARDED_REPLICATE_CALLS = {"replicate", "replicated_sharding"}
+
+PIPELINE = os.path.join(PKG, "experiment", "pipeline.py")
+# Mirror of experiment/pipeline.PIPELINE_COORDINATOR_FNS (kept in both
+# places so the lint works without importing jax): the coordinator tier
+# of the speculative scorer.  Each must exist; none may device-sync.
+PIPELINE_COORDINATOR_FNS = ("_worker", "_score_slice", "_score_chunk",
+                            "publish_best", "finalize", "consume")
+_PIPELINE_SYNC_CALLS = {"block_until_ready", "device_get"}
 
 
 def _py_files():
@@ -177,6 +199,10 @@ def check() -> list:
 
     # 6. The sharded selection backend never un-shards the pool.
     problems.extend(check_sharded_selection())
+
+    # 7. The speculative-scoring coordinator never syncs the train
+    # stream.
+    problems.extend(check_pipeline_coordinator())
 
     return problems
 
@@ -275,6 +301,47 @@ def check_sharded_selection(kcenter_path: str = KCENTER) -> list:
                     f"{rel}:{node.lineno}: {name} calls {called}() — "
                     "replicating a row-sharded array rebuilds the "
                     "single-chip ceiling the sharded pool removes")
+    return problems
+
+
+def check_pipeline_coordinator(pipeline_path: str = PIPELINE) -> list:
+    """The pipelined round's overlap invariant, statically (check 7):
+    the speculative-scoring coordinator functions may enqueue device
+    work and wait on host-side conditions, but a ``block_until_ready``
+    or ``device_get`` call inside them would sync the train stream's
+    arrays — serializing the two streams the pipeline exists to
+    overlap.  Chunk-output fetches live inside collect_pool (scoring
+    tier), and the CPU-only execution drain lives in
+    mesh_lib.DispatchGate; neither is a coordinator function."""
+    problems = []
+    rel = os.path.relpath(pipeline_path, REPO)
+    try:
+        with open(pipeline_path) as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError) as e:
+        return [f"{rel}: unreadable for the pipeline-coordinator "
+                f"check ({e})"]
+    fns = {node.name: node for node in ast.walk(tree)
+           if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name in PIPELINE_COORDINATOR_FNS:
+        fn = fns.get(name)
+        if fn is None:
+            problems.append(
+                f"{rel}: pipeline coordinator function {name} not found "
+                "— the never-sync enforcement has nothing to check")
+            continue
+        for node in ast.walk(fn):
+            called = ""
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    called = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    called = node.func.id
+            if called in _PIPELINE_SYNC_CALLS:
+                problems.append(
+                    f"{rel}:{node.lineno}: {name} calls {called} — the "
+                    "speculative-scoring coordinator must never sync "
+                    "the train stream (DESIGN.md §8)")
     return problems
 
 

@@ -138,7 +138,9 @@ class TestDegradedModeLine:
                 base, phase="al_round_cifar", ips=400.0,
                 ips_per_chip=400.0, batch_per_chip=128,
                 round_sec_warm=22.0, round_sec_cold=80.0,
-                feed_source="resident", feed_stall_frac=0.01),
+                feed_source="resident", feed_stall_frac=0.01,
+                round_pipeline="speculative", overlap_frac=0.31,
+                round_vs_max_phase=1.18, spec_hit_frac=1.0),
             # n_chips stays 1 (the cache rides only when the entry's
             # hardware matches the live 1-device CPU probe); the layout
             # tag is what's being plumbed here.
@@ -167,6 +169,15 @@ class TestDegradedModeLine:
         rd = out["phases"]["al_round_cifar"]
         assert rd["feed"] == "resident"
         assert rd["stall"] == pytest.approx(0.01)
+        # The pipelined round's mode + warm overlap (ISSUE 7): a round
+        # wall-clock claim is ambiguous without knowing whether the
+        # phases were overlapped, so both ride the end-to-end phases.
+        assert rd["pipeline"] == "speculative"
+        assert rd["overlap"] == pytest.approx(0.31)
+        # ... but the finer breakdown (round_vs_max_phase, spec_hit_
+        # frac) stays in the evidence file, off the bounded line.
+        assert "round_vs_max_phase" not in rd
+        assert "spec_hit_frac" not in rd
         # The sharded-pool probe's layout attribution (ISSUE 6): a
         # row-sharded max-N claim is meaningless without the layout tag.
         assert out["phases"]["kcenter_select_maxn"][

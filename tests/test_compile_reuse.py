@@ -323,6 +323,62 @@ class TestResidentBudgetDemotion:
             cache=cache)
 
 
+class TestPipelinedRoundCompileReuse:
+    def test_warm_pipelined_rounds_zero_new_compiles(self, tmp_path):
+        """The pipelined round's compile-freeness (DESIGN.md §8): the
+        speculative scorer dispatches THE SAME jitted score step the
+        sequential query uses (over batch-constant chunk shapes), and
+        the select-time prefetch pre-builds the very execution form the
+        fit would build — so warm pipelined rounds add ZERO compiles.
+        3 rounds so round 1 is a fully-warm ARMING round: it consumes
+        round 0's speculation, runs the scorer through its own fit, and
+        prefetches round 2's feed — the whole pipeline surface, jit
+        delta 0 (the same registry-counted metric the production driver
+        exports)."""
+        import json
+        import os
+
+        from active_learning_tpu.config import (ExperimentConfig,
+                                                TelemetryConfig)
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from active_learning_tpu.experiment import arg_pools  # noqa: F401
+        from active_learning_tpu.experiment.driver import run_experiment
+        from active_learning_tpu.utils.metrics import JsonlSink
+
+        from helpers import TinyClassifier, tiny_train_config
+
+        tmp = str(tmp_path)
+        cfg = ExperimentConfig(
+            dataset="synthetic", arg_pool="synthetic",
+            strategy="MarginSampler", rounds=3, round_budget=8,
+            n_epoch=2, early_stop_patience=2, log_dir=tmp, ckpt_path=tmp,
+            exp_hash="pipewarm", round_pipeline="speculative",
+            telemetry=TelemetryConfig(enabled=True,
+                                      heartbeat_every_s=0.0))
+        data = get_data_synthetic(n_train=96, n_test=32, num_classes=4,
+                                  image_size=8, seed=5)
+        strategy = run_experiment(
+            cfg, sink=JsonlSink(tmp, experiment_key="pipewarm"),
+            data=data, train_cfg=tiny_train_config(),
+            model=TinyClassifier(num_classes=4))
+        assert strategy.pipeline is not None
+        deltas = {}
+        with open(os.path.join(tmp, "metrics.jsonl")) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                if (ev.get("kind") == "metric"
+                        and "jit_cache_miss_delta" in ev.get("metrics",
+                                                             {})):
+                    deltas[ev.get("step")] = \
+                        ev["metrics"]["jit_cache_miss_delta"]
+        assert set(deltas) == {0, 1, 2}
+        assert deltas[0] > 0  # round 0 pays the cold compiles ...
+        for rd in (1, 2):  # ... and warm pipelined rounds pay none.
+            assert deltas[rd] == 0, (
+                f"warm pipelined round {rd} compiled: "
+                f"{deltas[rd]} jit cache misses")
+
+
 class TestCompilationCacheConfig:
     def test_driver_enables_persistent_cache(self, tmp_path, monkeypatch):
         from active_learning_tpu.experiment import driver

@@ -400,6 +400,11 @@ class TestEndToEndSmoke:
         spans = {e["name"]: e for e in events}
         exp = spans["experiment"]
         for e in events:
+            if e.get("ph") == "M":
+                # Metadata events (the pipelined round's thread_name
+                # track labels) carry no timestamp by the trace-event
+                # spec.
+                continue
             assert e["ts"] >= exp["ts"] - 1e-6
             assert (e["ts"] + e.get("dur", 0.0)
                     <= exp["ts"] + exp["dur"] + 1e-6)
@@ -577,6 +582,55 @@ class TestTraceLint:
         empty.write_text("def unrelated():\n    pass\n")
         problems = lint.check_sharded_selection(str(empty))
         assert any("not found" in p for p in problems)
+
+    def test_lint_flags_train_stream_sync_in_pipeline_coordinator(
+            self, tmp_path):
+        """The pipelined round's never-sync-the-train-stream invariant
+        (check 7, DESIGN.md §8): a coordinator function calling
+        block_until_ready or device_get must fail the lint, and deleting
+        a coordinator function drops to 'not found' — the enforcement
+        cannot be renamed away."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_lint", os.path.join(REPO, "scripts", "trace_lint.py"))
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+
+        bad = tmp_path / "pipeline.py"
+        bad.write_text(
+            "import jax\n"
+            "def _worker(self):\n"
+            "    jax.block_until_ready(self.out)\n"
+            "def _score_slice(self, plan, sl, variables):\n"
+            "    return jax.device_get(variables)\n"
+            "def _score_chunk(self, plan, sl, tag, variables, i):\n"
+            "    return None\n"
+            "def publish_best(self, r, e, v):\n"
+            "    pass\n"
+            "def finalize(self, r, e):\n"
+            "    pass\n"
+            "def consume(self, kind, keys, idxs, bs, variables):\n"
+            "    return None\n")
+        problems = lint.check_pipeline_coordinator(str(bad))
+        assert any("_worker" in p and "block_until_ready" in p
+                   for p in problems)
+        assert any("_score_slice" in p and "device_get" in p
+                   for p in problems)
+        assert len(problems) == 2  # the clean coordinators stay clean
+
+        # Renaming a coordinator away is itself a finding.
+        missing = tmp_path / "pipeline_missing.py"
+        missing.write_text("def unrelated():\n    pass\n")
+        problems = lint.check_pipeline_coordinator(str(missing))
+        assert any("not found" in p for p in problems)
+
+        # The REAL pipeline module is clean, and the lint's fn list
+        # mirrors the module's own (kept in both places so the lint
+        # works without importing jax).
+        assert lint.check_pipeline_coordinator() == []
+        from active_learning_tpu.experiment import pipeline as pipe_lib
+        assert tuple(lint.PIPELINE_COORDINATOR_FNS) == tuple(
+            pipe_lib.PIPELINE_COORDINATOR_FNS)
 
         # The REAL backend is clean, and the module's own fn list stays
         # in lockstep with the lint's mirror (renames can't silently
