@@ -285,6 +285,16 @@ class TelemetryConfig:
     # disables.  The serve path exposes the same exposition format live
     # at /metrics?format=prometheus.
     prometheus_file: Optional[str] = None
+    # What a CONFIRMED stall does beyond logging (DESIGN.md §10):
+    #   "log"       log + stall_suspected metric (the pre-fault-model
+    #               behavior);
+    #   "snapshot"  also journal the stall into round_journal.json
+    #               (status="stalled", stalled_s) for post-mortems and
+    #               `status --strict`;
+    #   "degrade"   snapshot + ask the degradation ladder to escalate at
+    #               the driver's next safe point (the watchdog thread
+    #               itself never mutates run state).
+    watchdog_action: str = "log"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -431,6 +441,16 @@ class ExperimentConfig:
     # process-wide at run start).  None = ~/.cache/al_tpu_xla_cache
     # (or $JAX_COMPILATION_CACHE_DIR); "" disables.
     compilation_cache_dir: Optional[str] = None
+
+    # Deterministic fault injection (active_learning_tpu/faults/,
+    # DESIGN.md §10): a comma-separated arming spec like
+    # "h2d_upload:raise@3,ckpt_write:torn@1,spec_scorer:die@0.5" —
+    # site:action[@arg] with int args = Nth-hit triggers (fire once),
+    # float args = seeded per-hit probabilities, "delay" args = seconds.
+    # None defers to $AL_FAULT_SPEC; unset leaves every site a
+    # zero-cost no-op.  Chaos tests arm this to make every recovery
+    # claim replayable (tests/test_faults.py).
+    fault_spec: Optional[str] = None
 
     # VAAL
     vaal: VAALConfig = dataclasses.field(default_factory=VAALConfig)

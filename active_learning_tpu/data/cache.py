@@ -273,6 +273,8 @@ def device_prefetch(batches, put, depth: int = 2):
     import queue
     import threading
 
+    from .. import faults
+
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
     DONE, ERROR = object(), object()
@@ -280,6 +282,13 @@ def device_prefetch(batches, put, depth: int = 2):
     def feed():
         try:
             for batch in batches:
+                # Fault point for the feeder thread (raise AND thread
+                # death land here): either way the BaseException forward
+                # below delivers it to the consuming ``next()``, which
+                # fails the PASS, never hangs it — callers retry the
+                # whole pass (Strategy.collect_scores) or ride the
+                # driver's round-retry ladder (the train feed).
+                faults.site("feed_worker")
                 item = put(batch)
                 while not stop.is_set():
                     try:

@@ -94,6 +94,18 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--prometheus_file", type=str, default=None,
                    help="atomically rewrite this Prometheus textfile-"
                         "collector scrape file with run gauges")
+    p.add_argument("--watchdog_action", type=str, default="log",
+                   choices=["log", "snapshot", "degrade"],
+                   help="what a confirmed stall does beyond logging: "
+                        "snapshot journals it into round_journal.json; "
+                        "degrade also triggers the degradation ladder at "
+                        "the next safe point (DESIGN.md §10)")
+    p.add_argument("--fault_spec", type=str, default=None,
+                   help="deterministic fault injection, e.g. "
+                        "'h2d_upload:raise@3,ckpt_write:torn@1' — "
+                        "site:action[@arg]; defaults to $AL_FAULT_SPEC; "
+                        "unset = every site is a zero-cost no-op "
+                        "(DESIGN.md §10)")
     # Compute precision (TPU-specific; the reference is fp32-only,
     # get_networks.py:28-29).  Default defers to the arg pool's
     # TrainConfig.dtype, whose "auto" means bf16 on TPU / f32 elsewhere.
@@ -219,7 +231,9 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
             export_trace=args.export_trace,
             watchdog=args.watchdog,
             stall_deadline_s=args.stall_deadline_s,
-            prometheus_file=args.prometheus_file),
+            prometheus_file=args.prometheus_file,
+            watchdog_action=args.watchdog_action),
+        fault_spec=args.fault_spec,
         dtype=args.dtype,
         bn_stats_dtype=args.bn_stats_dtype,
         stem=args.stem,
@@ -265,12 +279,20 @@ def main(argv: Optional[List[str]] = None):
     if argv and argv[0] == "status":
         from ..telemetry.status import main as status_main
         return status_main(argv[1:])
+    from ..faults.preempt import PreemptionRequested
     from .driver import run_experiment
     args = get_parser().parse_args(argv)
     # run_experiment performs the jax.distributed rendezvous itself (a
     # no-op without the multi-host config fields), so programmatic callers
     # get the same behavior as the CLI.
-    return run_experiment(args_to_config(args))
+    try:
+        return run_experiment(args_to_config(args))
+    except PreemptionRequested:
+        # Graceful preemption (SIGTERM/SIGINT): the durable state is
+        # checkpointed and consistent — exit 0 so orchestrators treat
+        # the eviction as clean; --resume_training continues the run
+        # bit-identically.
+        return 0
 
 
 if __name__ == "__main__":

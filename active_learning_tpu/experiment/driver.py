@@ -20,17 +20,22 @@ metric names (main_al.py:24-40).
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import os
 import uuid
+import zlib
 from datetime import date
 from typing import Optional, Tuple
 
 import jax
 import numpy as np
 
+from .. import faults
 from ..config import ExperimentConfig, TrainConfig, config_to_dict
 from ..data import get_data
+from ..faults import ladder as ladder_lib
+from ..faults import preempt as preempt_lib
 from ..initial_pool import generate_eval_idxs, generate_init_lb_idxs
 from ..models.factory import get_network
 from ..parallel import mesh as mesh_lib
@@ -38,6 +43,7 @@ from ..pool import PoolState
 from ..strategies import get_strategy
 from ..telemetry import runtime as tele_runtime
 from ..telemetry import spans as tele_spans
+from ..train import checkpoint as ckpt_lib
 from ..utils.logging import get_logger, setup_logging
 from ..utils.metrics import MetricsSink, make_sink
 from ..utils.tracing import phase_timer, profiler_session
@@ -269,17 +275,29 @@ def _emit_overlap_telemetry(telemetry, sink: MetricsSink, rd: int,
 
 
 def _emit_round_telemetry(telemetry, sink: MetricsSink, rd: int,
-                          strategy) -> None:
+                          strategy, ladder=None,
+                          retries_baseline: int = 0) -> None:
     """Round-boundary telemetry: the jit-compile miss delta (round 0
     carries the cold tax; ANY nonzero delta after it is a shape leak —
     the test_compile_reuse regression, now visible in production
     metrics), the HBM high-water where the backend exposes
-    memory_stats, the Prometheus gauge refresh, and an incremental
+    memory_stats, the failure-model counters (fault_retries_total
+    cumulative, degrade_events — DESIGN.md §10; bench rides both on the
+    al_round phases), the Prometheus gauge refresh, and an incremental
     trace export so a crash mid-run still leaves trace.json on disk."""
     if not telemetry.train_metrics:
         return
     delta = telemetry.jit_cache_delta()
     sink.log_metric("jit_cache_miss_delta", delta, step=rd)
+    # Per-RUN retries: the process counter is cumulative across every
+    # run/phase sharing this interpreter (bench runs many), so the
+    # run-start baseline is subtracted — the al_round retries rider must
+    # attribute only what the measured rounds absorbed.
+    retries = faults.retry_counters()
+    run_retries = retries["total"] - retries_baseline
+    sink.log_metric("fault_retries_total", run_retries, step=rd)
+    sink.log_metric("degrade_events",
+                    ladder.events if ladder is not None else 0, step=rd)
     hbm = tele_runtime.hbm_high_water_gb()
     if hbm is not None:
         sink.log_metric("hbm_peak_gb", hbm, step=rd)
@@ -294,11 +312,69 @@ def _emit_round_telemetry(telemetry, sink: MetricsSink, rd: int,
         labeled=strategy.pool.num_labeled,
         jit_cache_total=telemetry.jit_cache_total(),
         hbm_peak_gb=hbm,
+        fault_retries_total=run_retries,
+        degrade_active=(len(ladder.active) if ladder is not None else 0),
         feed_stall_frac=feed.get("feed_stall_frac"),
         host_wait_ms_p50=feed.get("host_wait_ms_p50"))
     telemetry.write_prometheus()
     telemetry.export_trace()
     telemetry.tick(force=True, phase="round_end", round=rd)
+
+
+def _labeled_crc(pool: PoolState) -> int:
+    """CRC of the labeled mask — the round journal's cheap labeled-set
+    digest (a resume/retry that diverged would show a different CRC at
+    the same round, without dumping 1.2M indices into a JSON file)."""
+    return int(zlib.crc32(np.ascontiguousarray(pool.labeled).tobytes()))
+
+
+def _round_snapshot(strategy) -> dict:
+    """Everything a ROUND mutates, captured at its start so a failed
+    attempt can be rolled back and retried bit-identically (the
+    degradation ladder, DESIGN.md §10): pool state, the host rng chain,
+    the per-experiment init key, and a host copy of the model variables
+    (round r's query scores with round r-1's best weights — re-running
+    the query without restoring them would score with the failed
+    attempt's re-initialized model)."""
+    variables = None
+    if strategy.state is not None:
+        variables = jax.tree.map(np.asarray, strategy.state.variables)
+    return {
+        "pool": strategy.pool.to_arrays(),
+        "rng_state": copy.deepcopy(strategy.rng.bit_generator.state),
+        "init_key": np.asarray(strategy._init_key).copy(),
+        "best_epoch": int(strategy.best_epoch),
+        "resume_next_fit": bool(strategy.resume_next_fit),
+        "variables": variables,
+    }
+
+
+def _restore_round_snapshot(strategy, snap: dict,
+                            round_idx: Optional[int] = None) -> None:
+    """Roll the strategy back to the round-start snapshot.  The
+    ATTEMPTED round's stale mid-fit state is deleted too: it was written
+    under an rng chain this restore just rewound, and resuming from it
+    would splice two divergent attempts together.  (``round_idx`` names
+    that round explicitly — the pool restore rewinds ``strategy.round``
+    to the previous round's value, so weight_paths() alone would point
+    at the wrong fit state.)"""
+    if round_idx is not None:
+        fit_state = ckpt_lib.weight_paths(
+            strategy.cfg.ckpt_path, strategy.cfg.exp_name,
+            strategy.exp_hash, round_idx)["fit_state"]
+        ckpt_lib.delete_fit_state(fit_state)
+    strategy.pool = PoolState.from_arrays(snap["pool"])
+    strategy.rng.bit_generator.state = copy.deepcopy(snap["rng_state"])
+    strategy._init_key = jax.numpy.asarray(snap["init_key"])
+    strategy.best_epoch = snap["best_epoch"]
+    strategy.resume_next_fit = snap["resume_next_fit"]
+    if snap["variables"] is None:
+        strategy.state = None
+    elif strategy.state is not None:
+        # Re-replicates from the host copies — fresh device buffers, so
+        # arrays the failed attempt donated are never read again.
+        strategy.state = strategy.trainer.replace_variables(
+            strategy.state, snap["variables"])
 
 
 def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
@@ -317,6 +393,16 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     # Persistent executable reuse across rounds AND runs (config update
     # only — safe before or after backend init).
     enable_compilation_cache(cfg.compilation_cache_dir)
+    # Arm the fault-injection registry (DESIGN.md §10) ONLY when a spec
+    # is explicitly given — a run with neither --fault_spec nor
+    # $AL_FAULT_SPEC must not clobber an arming a test installed
+    # programmatically before calling run_experiment.  What this run
+    # arms, its finally disarms: the registry is process-global, and a
+    # spec leaking into the NEXT in-process run (bench phases, pytest)
+    # would corrupt a clean measurement with no indication why.
+    fault_spec = cfg.fault_spec or os.environ.get("AL_FAULT_SPEC")
+    if fault_spec:
+        faults.configure(fault_spec, seed=cfg.run_seed)
 
     if cfg.exp_hash is None:
         cfg.exp_hash = uuid.uuid4().hex[:9]
@@ -335,15 +421,42 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         log_filename = log_filename.replace(
             ".log", f"_p{jax.process_index()}.log")
     logger = setup_logging(cfg.log_dir, log_filename)
+    if fault_spec:
+        logger.warning(f"fault injection ARMED: {fault_spec} "
+                       f"(seed {cfg.run_seed}); disarmed at run exit")
 
     resuming = cfg.resume_training and resume_lib.has_saved_experiment(cfg)
+    preempted_round0 = False
     if cfg.resume_training and not resuming:
-        # Never silently restart a run the user asked to resume (the
-        # reference would die unpickling a missing file, resume_training.py:13).
-        raise FileNotFoundError(
-            f"--resume_training: no saved experiment state for "
-            f"exp_name={cfg.exp_name!r} exp_hash={cfg.exp_hash!r} under "
-            f"{cfg.ckpt_path!r}; pass the original --exp_hash/--ckpt_path")
+        # No completed round on disk.  One legitimate way to get here:
+        # preempted (SIGTERM/SIGINT) DURING round 0, before the first
+        # save_experiment — the journal records it, and the mid-fit
+        # state (epoch-granular, saved by the trainer's preemption
+        # boundary) is the only durable progress.  Restart round 0 and
+        # let its first fit consume that state; everything before the
+        # fit (init pool, eval split, init weights) is a deterministic
+        # replay of the same seeds, so the resumed run still reproduces
+        # the uninterrupted one bit-identically (tests/test_faults.py).
+        prior = faults.read_journal(
+            os.path.join(cfg.log_dir, faults.JOURNAL_FILE))
+        if (prior is not None and prior.get("status") == "preempted"
+                and prior.get("exp_hash") == cfg.exp_hash
+                and prior.get("exp_name") == cfg.exp_name
+                and int(prior.get("round", -1)) == 0):
+            # The identity check matters: the journal is keyed by
+            # log_dir, not by experiment — a forgotten --exp_hash (a
+            # fresh uuid was just minted above) or a preemption at
+            # round N re-run against the wrong --ckpt_path must still
+            # hit the explicit error below, not silently restart.
+            preempted_round0 = True
+        else:
+            # Never silently restart a run the user asked to resume (the
+            # reference would die unpickling a missing file,
+            # resume_training.py:13).
+            raise FileNotFoundError(
+                f"--resume_training: no saved experiment state for "
+                f"exp_name={cfg.exp_name!r} exp_hash={cfg.exp_hash!r} under "
+                f"{cfg.ckpt_path!r}; pass the original --exp_hash/--ckpt_path")
     if sink is None:
         key = (resume_lib.saved_experiment_key(cfg) if resuming
                else cfg.exp_hash)
@@ -351,11 +464,29 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         sink = make_sink(cfg.enable_metrics and mesh_lib.is_coordinator(),
                          cfg.log_dir, experiment_key=key,
                          backend=cfg.metrics_backend)
+    # The round journal (faults/journal.py): WHERE the run is — round/
+    # phase/attempt, labeled-set digest, active degradation rungs,
+    # terminal status — atomically rewritten next to the heartbeat so
+    # `status --strict` and post-mortems read it with no jax import.
+    journal = faults.RoundJournal(
+        os.path.join(cfg.log_dir, faults.JOURNAL_FILE),
+        enabled=mesh_lib.is_coordinator())
+    # Identity first: a preemption at ANY later point leaves a journal
+    # the round-0 resume path above can verify belongs to THIS
+    # experiment (the journal is keyed by log_dir, not exp_hash).
+    journal.write(exp_name=cfg.exp_name, exp_hash=cfg.exp_hash)
+    # The ladder is built after the strategy exists; the watchdog's
+    # callback closes over this box so a stall can reach it.
+    ladder_box: dict = {}
+
     # Run-wide telemetry (DESIGN.md §7): heartbeat + spans + per-step
     # metrics + optional watchdog/trace/scrape file, installed BEFORE the
     # stack is built so the trainer/strategies register their jitted
     # steps with the compile counter.  The watchdog's stall event rides
-    # the metrics sink (thread-safe by JsonlSink's lock).
+    # the metrics sink (thread-safe by JsonlSink's lock); with
+    # --watchdog_action snapshot/degrade it also journals the stall, and
+    # degrade additionally asks the ladder for escalation at the next
+    # safe point (the watchdog thread itself never mutates run state).
     def _on_stall(stalled_s: float) -> None:
         logger.warning(
             f"watchdog: no progress for {stalled_s:.0f}s (deadline "
@@ -363,6 +494,11 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         sink.log_metric("stall_suspected", round(stalled_s, 1))
         tele_spans.get_tracer().instant(
             "stall_suspected", args={"stalled_s": round(stalled_s, 1)})
+        action = getattr(cfg.telemetry, "watchdog_action", "log")
+        if action in ("snapshot", "degrade"):
+            journal.write(status="stalled", stalled_s=round(stalled_s, 1))
+        if action == "degrade" and ladder_box.get("ladder") is not None:
+            ladder_box["ladder"].request_stall()
 
     telemetry = tele_runtime.start_run(
         cfg.telemetry, log_dir=cfg.log_dir,
@@ -373,9 +509,17 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     # Everything from here runs under the run's telemetry; the finally
     # below both finishes it (final heartbeat status + trace export) and
     # UNINSTALLS it — an exception anywhere, including setup, must not
-    # leak an installed runtime into the next in-process run.
+    # leak an installed runtime into the next in-process run.  Preemption
+    # handlers install for the same span: SIGTERM/SIGINT record a
+    # request that the trainer's epoch boundaries and the driver's phase
+    # boundaries turn into checkpoint-and-exit (faults/preempt.py).
     status = "crashed"
     pipeline = None
+    # Per-run retry baseline: the process counter never resets (other
+    # runs/phases in this interpreter own their own slices of it).
+    run_retries0 = faults.retry_counters()["total"]
+    preempt_lib.reset()
+    prev_handlers = preempt_lib.install(logger)
     try:
         strategy = build_experiment(cfg, sink=sink, data=data, mesh=mesh,
                                     train_cfg=train_cfg, model=model,
@@ -389,6 +533,14 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         else:
             start_round = 0
             sink.log_parameters(config_to_dict(cfg))
+            if preempted_round0:
+                # Preempted mid-round-0: replay the round from its seeds
+                # but let the first fit consume the mid-fit state the
+                # preemption boundary saved.
+                logger.info(
+                    "resume: journal records a round-0 preemption; "
+                    "replaying round 0 and consuming its mid-fit state")
+                strategy.resume_next_fit = True
 
         init_pool_size = cfg.resolved_init_pool_size()
         logger.info(f"Experiment Name: {cfg.exp_name}")
@@ -404,7 +556,10 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         # scoring overlaps the fit's patience tail, consumed by
         # Strategy.collect_scores at the next query.  Installed on the
         # strategy (train() wires the best-ckpt publish into fit);
-        # bit-identical to the sequential loop by contract.
+        # bit-identical to the sequential loop by contract.  The
+        # degradation ladder may detach it for a degraded round
+        # (strategy.pipeline is the live switch; this local keeps the
+        # shutdown handle either way).
         pipeline_mode = pipeline_lib.resolve_round_pipeline(
             cfg.round_pipeline, strategy.mesh)
         if pipeline_mode == "speculative":
@@ -412,73 +567,139 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
             strategy.pipeline = pipeline
         logger.info(f"Round pipeline: {pipeline_mode}")
 
+        # The degradation ladder (faults/ladder.py, DESIGN.md §10): a
+        # failure that survives the site-level retries costs a ROUND
+        # ATTEMPT, not the run — the round rolls back to its snapshot
+        # and re-runs one rung down.  The save below rides the unified
+        # retry policy too (transient IO never loses a completed round).
+        ladder = ladder_lib.DegradationLadder(strategy, logger=logger,
+                                              sink=sink, journal=journal)
+        ladder_box["ladder"] = ladder
+        save_retry = faults.RetryPolicy(site="experiment_save",
+                                        classify=faults.classify_exception)
+
+        def _boundary(rd: int, phase: str) -> None:
+            """A driver safe point: journal where we are, then honor a
+            recorded preemption or a watchdog degrade request.  The
+            durable state is consistent at every boundary by
+            construction (atomic saves, monotonic tags)."""
+            journal.write(round=rd, phase=phase)
+            preempt_lib.check()
+            ladder.check_stall()
+
+        def _run_round(rd: int, attempt: int):
+            """One round attempt — the reference loop body, verb for
+            verb.  Returns (phase walls, round span) for the overlap
+            accounting; raises to the attempt loop on failure."""
+            phase_s = {}
+            with tele_spans.get_tracer().span(
+                    "round", args={"round": rd,
+                                   "attempt": attempt}) as round_sp:
+                strategy.round = rd
+                telemetry.tick(force=True, round=rd,
+                               phase="round_start", epoch=0, step=0)
+                journal.write(status="running", round=rd,
+                              phase="round_start", attempt=attempt,
+                              labeled=strategy.pool.num_labeled,
+                              labeled_crc=_labeled_crc(strategy.pool),
+                              degrade=list(ladder.active),
+                              pipeline_armed=bool(strategy.pipeline))
+                logger.info(f"Active Learning Round {rd} start.")
+                # Pool residency is default behavior: re-size the auto
+                # budget from live HBM headroom at every round start (a
+                # no-op for explicit integer budgets; already-uploaded
+                # pools stay resident regardless —
+                # parallel/resident.cached).
+                budget = strategy.trainer.refresh_resident_budget()
+                logger.info(
+                    f"Resident pool budget for round {rd}: "
+                    f"{budget / 1e9:.2f} GB "
+                    f"({'auto' if strategy.train_cfg.resident_scoring_bytes is None else 'explicit'}, "
+                    f"per chip, {strategy.trainer.pool_sharding} layout)")
+
+                # Round 0 only queries when there is no initial pool —
+                # with an SSL or transfer-learned init the model can
+                # score the pool before any labels exist
+                # (main_al.py:149-157).
+                al_round_0 = rd == 0 and init_pool_size == 0
+                if rd > 0 or al_round_0:
+                    if al_round_0:
+                        strategy.init_network_weights()
+                    with phase_timer("query_time", rd, sink,
+                                     logger) as sp:
+                        labeled_idxs, cur_cost = strategy.query(
+                            cfg.round_budget)
+                    phase_s["query"] = sp.duration_s
+                    strategy.update(labeled_idxs, cur_cost)
+                    _boundary(rd, "query")
+
+                with phase_timer("init_network_weights_time", rd, sink,
+                                 logger) as sp:
+                    strategy.init_network_weights()
+                phase_s["init"] = sp.duration_s
+                _boundary(rd, "init")
+                # Arm the speculative plan for the NEXT round's query
+                # before the fit starts publishing best checkpoints —
+                # the scorer overlaps the fit's patience tail.  The
+                # last round has no next query: nothing to speculate.
+                if strategy.pipeline is not None and rd + 1 < cfg.rounds:
+                    strategy.pipeline.arm(rd)
+                with phase_timer("train_time", rd, sink, logger) as sp:
+                    strategy.train()
+                phase_s["train"] = sp.duration_s
+                _boundary(rd, "train")
+                with phase_timer("load_best_ckpt_time", rd, sink,
+                                 logger) as sp:
+                    strategy.load_best_ckpt()
+                phase_s["load_best"] = sp.duration_s
+                with phase_timer("test_time", rd, sink, logger) as sp:
+                    strategy.test()
+                phase_s["test"] = sp.duration_s
+
+                # No preemption check between test and save: the round's
+                # work is done, so the completed round is persisted
+                # FIRST and the signal honored at the next boundary.
+                if mesh_lib.is_coordinator():
+                    save_retry.call(resume_lib.save_experiment,
+                                    strategy, cfg)
+                cfg.resume_training = True  # crash after this resumes (main_al.py:181)
+                journal.write(round=rd, phase="round_end",
+                              labeled=strategy.pool.num_labeled,
+                              labeled_crc=_labeled_crc(strategy.pool))
+            return phase_s, round_sp
+
         with profiler_session(cfg.profile_dir), \
                 tele_spans.get_tracer().span(
                     "experiment", args={"exp_name": cfg.exp_name,
                                         "exp_hash": cfg.exp_hash}):
             for rd in range(start_round, cfg.rounds):
-                # Per-phase walls for the overlap accounting, read from
-                # the SAME spans phase_timer records (one measurement:
-                # metric, log, trace, and overlap_frac all agree).
-                phase_s = {}
-                with tele_spans.get_tracer().span(
-                        "round", args={"round": rd}) as round_sp:
-                    strategy.round = rd
-                    telemetry.tick(force=True, round=rd,
-                                   phase="round_start", epoch=0, step=0)
-                    logger.info(f"Active Learning Round {rd} start.")
-                    # Pool residency is default behavior: re-size the auto
-                    # budget from live HBM headroom at every round start (a
-                    # no-op for explicit integer budgets; already-uploaded
-                    # pools stay resident regardless —
-                    # parallel/resident.cached).
-                    budget = strategy.trainer.refresh_resident_budget()
-                    logger.info(
-                        f"Resident pool budget for round {rd}: "
-                        f"{budget / 1e9:.2f} GB "
-                        f"({'auto' if strategy.train_cfg.resident_scoring_bytes is None else 'explicit'}, "
-                        f"per chip, {strategy.trainer.pool_sharding} layout)")
-
-                    # Round 0 only queries when there is no initial pool —
-                    # with an SSL or transfer-learned init the model can
-                    # score the pool before any labels exist
-                    # (main_al.py:149-157).
-                    al_round_0 = rd == 0 and init_pool_size == 0
-                    if rd > 0 or al_round_0:
-                        if al_round_0:
-                            strategy.init_network_weights()
-                        with phase_timer("query_time", rd, sink,
-                                         logger) as sp:
-                            labeled_idxs, cur_cost = strategy.query(
-                                cfg.round_budget)
-                        phase_s["query"] = sp.duration_s
-                        strategy.update(labeled_idxs, cur_cost)
-
-                    with phase_timer("init_network_weights_time", rd, sink,
-                                     logger) as sp:
-                        strategy.init_network_weights()
-                    phase_s["init"] = sp.duration_s
-                    # Arm the speculative plan for the NEXT round's query
-                    # before the fit starts publishing best checkpoints —
-                    # the scorer overlaps the fit's patience tail.  The
-                    # last round has no next query: nothing to speculate.
-                    if pipeline is not None and rd + 1 < cfg.rounds:
-                        pipeline.arm(rd)
-                    with phase_timer("train_time", rd, sink, logger) as sp:
-                        strategy.train()
-                    phase_s["train"] = sp.duration_s
-                    with phase_timer("load_best_ckpt_time", rd, sink,
-                                     logger) as sp:
-                        strategy.load_best_ckpt()
-                    phase_s["load_best"] = sp.duration_s
-                    with phase_timer("test_time", rd, sink, logger) as sp:
-                        strategy.test()
-                    phase_s["test"] = sp.duration_s
-
-                    if mesh_lib.is_coordinator():
-                        resume_lib.save_experiment(strategy, cfg)
-                    cfg.resume_training = True  # crash after this resumes (main_al.py:181)
-                if pipeline is not None:
+                preempt_lib.check()
+                # Degradation is per-round: every round starts at full
+                # capability; a systematic fault re-engages the ladder,
+                # a transient one stays recovered.
+                ladder.relax(rd)
+                snapshot = _round_snapshot(strategy)
+                for attempt in range(ladder.max_attempts()):
+                    try:
+                        phase_s, round_sp = _run_round(rd, attempt)
+                        break
+                    except preempt_lib.PreemptionRequested:
+                        raise
+                    except ladder_lib.DegradeRequested as exc:
+                        if ladder.escalate(exc, rd) is None:
+                            raise
+                        _restore_round_snapshot(strategy, snapshot, rd)
+                    except (Exception, faults.ThreadDeath) as exc:
+                        # Quiesce a possibly mid-chunk scorer before
+                        # rolling back (escalate's pipeline_off rung
+                        # also disarms; this covers the other rungs).
+                        if strategy.pipeline is not None:
+                            strategy.pipeline.disarm()
+                        if ladder.escalate(exc, rd) is None:
+                            raise
+                        _restore_round_snapshot(strategy, snapshot, rd)
+                pipe = strategy.pipeline
+                if pipe is not None:
                     # Scorer busy minus the round's gate contention on
                     # BOTH sides: chunk busy already excludes the
                     # scorer's own gate waits (pipeline._score_chunk),
@@ -488,19 +709,38 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
                     # (most visible in drain-mode CPU rounds, where a
                     # chunk's whole execution can stall the fit).
                     spec_s = max(
-                        0.0, pipeline.take_busy_s()
+                        0.0, pipe.take_busy_s()
                         - strategy.trainer.dispatch_lock.take_wait_s())
                 else:
                     spec_s = 0.0
                 _emit_overlap_telemetry(
                     telemetry, sink, rd, round_sp.duration_s, phase_s,
-                    spec_s, pipeline_mode)
-                _emit_round_telemetry(telemetry, sink, rd, strategy)
+                    spec_s, pipeline_mode if pipe is not None else "off")
+                _emit_round_telemetry(telemetry, sink, rd, strategy,
+                                      ladder,
+                                      retries_baseline=run_retries0)
                 if len(strategy.available_query_idxs(shuffle=False)) == 0:
                     logger.info("Finished querying all Images!")
                     break
         status = "finished"
+        journal.write(status="finished")
+    except preempt_lib.PreemptionRequested as exc:
+        # Checkpoint-and-exit: every durable artifact (experiment state,
+        # mid-round fit state, best checkpoints, this journal) is
+        # already consistent — the resumed run reproduces the
+        # uninterrupted one bit-identically (tests/test_faults.py).
+        status = "preempted"
+        journal.write(status="preempted", signal=int(exc.signum))
+        logger.info(
+            "preemption: durable state checkpointed; re-run with "
+            "--resume_training to continue bit-identically")
+        raise
     finally:
+        if fault_spec:
+            # Disarm only what THIS run armed (cleanup runs fault-free;
+            # a programmatic arming by the caller is left alone).
+            faults.configure(None)
+        preempt_lib.uninstall(prev_handlers)
         # Stop the speculative scorer BEFORE telemetry teardown: its
         # thread ticks the heartbeat and records spans, both of which
         # must not outlive the run they belong to.

@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import faults
 from ..strategies import scoring
 from ..telemetry import runtime as tele_runtime
 from ..telemetry import spans as tele_spans
@@ -74,8 +75,9 @@ WATCH_POLL_S = 2.0
 # Mirrored by scripts/trace_lint.py check 7 (the lint works without
 # importing jax): the coordinator tier of the speculative scorer.  Each
 # must exist, and none may call block_until_ready/device_get.
-PIPELINE_COORDINATOR_FNS = ("_worker", "_score_slice", "_score_chunk",
-                            "publish_best", "finalize", "consume")
+PIPELINE_COORDINATOR_FNS = ("_worker", "_worker_loop", "_score_slice",
+                            "_score_chunk", "publish_best", "finalize",
+                            "consume")
 
 
 def resolve_round_pipeline(spec: Optional[str], mesh) -> str:
@@ -125,7 +127,13 @@ class RoundPipeline:
         # recent hand-over for the driver's round metrics.
         self.stats = {"publishes": 0, "chunks_scored": 0,
                       "chunks_invalidated": 0, "chunks_inline": 0,
-                      "chunks_hit": 0, "plan_misses": 0}
+                      "chunks_hit": 0, "plan_misses": 0,
+                      # Chunk executions lost to an exception (the
+                      # best-effort contract: speculation dies for the
+                      # round, the query recomputes sequentially) —
+                      # observable so tests can tell an environmental
+                      # failure from a correctness bug.
+                      "chunks_failed": 0}
         self.last_consume: Dict[str, Any] = {}
 
     # -- round lifecycle (driver-facing) ----------------------------------
@@ -239,6 +247,43 @@ class RoundPipeline:
             busy, self._busy_s = self._busy_s, 0.0
         return busy
 
+    def disarm(self, wait_s: float = 60.0) -> None:
+        """Quiesce the scorer for THIS round without killing the thread
+        (the degradation ladder's pipeline_off rung: the retried round
+        runs sequentially, the NEXT round may re-arm).  Kills the plan,
+        waits out any in-flight chunk, releases the CPU-mesh drain, and
+        joins the prefetch thread.
+
+        The in-flight wait is BOUNDED: disarm is the recovery path, and
+        a scorer wedged mid-chunk (a stuck collective — possibly the
+        very stall being healed) would otherwise hang it forever.  On
+        expiry the chunk is abandoned loudly — its thread may still
+        complete later, but the dead plan means nothing consumes it."""
+        deadline = time.monotonic() + wait_s
+        with self._cv:
+            self._plan = None
+            self._consumed = True
+            self._cv.notify_all()
+            while self._in_flight is not None:
+                if self._thread is None or not self._thread.is_alive():
+                    self._in_flight = None
+                    break
+                if time.monotonic() >= deadline:
+                    self.logger.warning(
+                        "round pipeline: disarm abandoned an in-flight "
+                        "speculative chunk still running after "
+                        f"{wait_s:.0f}s (wedged scorer); the round "
+                        "proceeds sequentially")
+                    self._in_flight = None
+                    break
+                self._cv.wait(timeout=1.0)
+        self._strategy.trainer.dispatch_lock.drain_mode = False
+        self._join_prefetch()
+        try:
+            tele_runtime.get_run().tick(spec_phase="idle")
+        except Exception:  # noqa: BLE001 - best-effort heartbeat
+            pass
+
     def shutdown(self) -> None:
         with self._cv:
             self._stop = True
@@ -281,8 +326,16 @@ class RoundPipeline:
             # execution drain — on a miss the caller dispatches the
             # sequential pass immediately, and doing that concurrently
             # with the chunk's collectives un-drained is exactly the
-            # cross-thread deadlock the drain exists to prevent.
+            # cross-thread deadlock the drain exists to prevent.  A DEAD
+            # scorer thread (injected ThreadDeath, a hard crash) can
+            # never finish its chunk: its death harness clears
+            # _in_flight, and the liveness check below bounds the wait
+            # even if the harness itself was killed — a dead thread must
+            # cost a recompute, never a hang.
             while self._in_flight is not None:
+                if self._thread is None or not self._thread.is_alive():
+                    self._in_flight = None
+                    break
                 self._cv.wait(timeout=1.0)
             # The scorer thread is idle for good now (consumed + no
             # in-flight): single-threaded dispatch no longer needs the
@@ -347,6 +400,33 @@ class RoundPipeline:
             self._thread.start()
 
     def _worker(self) -> None:
+        """Thread entry: the loop plus the death harness.  An exception
+        the loop's own guards don't catch — injected ThreadDeath
+        (faults.site("spec_scorer")'s ``die`` action), a MemoryError, a
+        bug — must not orphan the round: the plan is killed, any
+        in-flight marker cleared (consume()'s wait would otherwise hang
+        on a chunk that will never finish), the CPU-mesh execution drain
+        released, and the heartbeat's scorer track idled.  The round
+        then completes sequentially — a dead scorer costs wall-clock,
+        never a score and never a hang."""
+        try:
+            self._worker_loop()
+        except BaseException:  # noqa: BLE001 - thread-death harness
+            self.logger.exception(
+                "round pipeline: speculative scorer thread died; the "
+                "round completes sequentially")
+            with self._cv:
+                self.stats["chunks_failed"] += 1
+                self._in_flight = None
+                self._plan = None
+                self._cv.notify_all()
+            self._strategy.trainer.dispatch_lock.drain_mode = False
+            try:
+                tele_runtime.get_run().tick(spec_phase="idle")
+            except Exception:  # noqa: BLE001 - already on the death path
+                pass
+
+    def _worker_loop(self) -> None:
         """The scoring executor loop: take the lowest pending chunk for
         the current source checkpoint, score it, store it under its tag.
         Never touches the train stream's arrays (trace_lint check 7) —
@@ -393,6 +473,7 @@ class RoundPipeline:
                     "round pipeline: speculative chunk failed; disabling "
                     "speculation for this round")
                 with self._cv:
+                    self.stats["chunks_failed"] += 1
                     self._in_flight = None
                     self._plan = None
                     self._cv.notify_all()
@@ -470,7 +551,17 @@ class RoundPipeline:
         self._last_poll = now
         try:
             polled = self._watcher.poll()
-        except Exception:  # noqa: BLE001 - a transient FS error is not fatal
+        except Exception as exc:  # noqa: BLE001 - classified below
+            # The unified classification (faults.classify_exception)
+            # instead of a blanket swallow: a transient FS error (NFS
+            # hiccup, racing rename) just waits for the next poll; a
+            # non-transient one disables THIS plan's disk leg loudly —
+            # the in-process publish leg still delivers every best.
+            if faults.classify_exception(exc) == faults.FATAL:
+                self.logger.exception(
+                    "round pipeline: best-ckpt disk poll failed "
+                    "(non-transient); disk leg disabled for this round")
+                self._watcher = None
             return
         if polled is None:
             return
@@ -508,6 +599,10 @@ class RoundPipeline:
             **strategy._resident_kwargs())
 
     def _score_chunk(self, plan, sl, tag, variables, chunk_i: int):
+        # The scorer thread's fault point: `raise` exercises the
+        # disable-speculation-for-the-round path, `die` the thread-death
+        # harness in _worker — both recover to sequential scoring.
+        faults.site("spec_scorer")
         gate = self._strategy.trainer.dispatch_lock
         gate.take_wait_s()  # drop waits accrued outside this chunk
         t0 = time.perf_counter()

@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from .. import faults
 from ..config import ExperimentConfig, config_to_dict
 from ..pool import PoolState
 # Shared weight-compatibility version: see its definition site for when it
@@ -51,6 +52,7 @@ def _state_dir(cfg: ExperimentConfig) -> str:
 def save_experiment(strategy, cfg: ExperimentConfig) -> str:
     """Persist end-of-round state.  Called once per round after ``test()``
     (reference: main_al.py:180 → save_experiment)."""
+    faults.site("ckpt_write")
     directory = _state_dir(cfg)
     os.makedirs(directory, exist_ok=True)
     arrays = strategy.pool.to_arrays()
@@ -71,6 +73,11 @@ def save_experiment(strategy, cfg: ExperimentConfig) -> str:
         # A stale aux blob from an older round of a sampler that stopped
         # producing one must not be restored later.
         os.remove(aux_path)
+    # Torn point between the state npz and the meta json: a crash here
+    # leaves a round-N state file with round-(N-1) (or no) meta — which
+    # has_saved_experiment/meta-last ordering reads as the LAST COMPLETE
+    # round, never a spliced pair (chaos-tested via ckpt_write:torn@N).
+    faults.site("ckpt_write", point="torn")
     meta = {
         "round": int(strategy.round),
         "model_format": MODEL_FORMAT_VERSION,

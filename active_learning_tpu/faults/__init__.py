@@ -1,0 +1,20 @@
+"""Fault injection + unified recovery (DESIGN.md §10).
+
+One package owns the failure model: the deterministic injection registry
+(``site``/``configure`` — registry.py), the one RetryPolicy with
+explicit transient-vs-fatal classification (retry.py), the atomic round
+journal (journal.py), the degradation ladder (ladder.py — imported
+directly by the driver, not re-exported: it touches the parallel stack),
+and driver preemption (preempt.py).
+
+jax-free at import time on purpose: telemetry/status.py reads the
+journal through this package with no backend touch.
+"""
+
+from .journal import JOURNAL_FILE, RoundJournal, read_journal  # noqa: F401
+from .preempt import PreemptionRequested  # noqa: F401
+from .registry import (ACTIONS, SITES, InjectedFault, InjectedOOM,  # noqa: F401
+                       ThreadDeath, active_spec, configure,
+                       fault_counters, parse_spec, site)
+from .retry import (FATAL, OOM, TRANSIENT, RetryPolicy,  # noqa: F401
+                    classify_exception, retry_counters)

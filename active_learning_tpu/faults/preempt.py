@@ -1,0 +1,107 @@
+"""Driver-side preemption: SIGTERM/SIGINT -> checkpoint-and-exit.
+
+Preemptible TPU pods make eviction the COMMON case, and until now only
+``serve/`` handled signals — a SIGTERM to the driver died wherever it
+stood, losing up to a whole round of mesh time.  The contract here:
+
+  * the signal handler only RECORDS the request (async-signal-safe; a
+    raise inside XLA's dispatch would corrupt the very state we want to
+    save) and logs once;
+  * the trainer checks at each epoch boundary — publishing any pending
+    best snapshot and saving the mid-round fit state first, so the
+    resumed fit continues bit-for-bit — and the driver checks at each
+    phase boundary; whichever sees the flag first raises
+    ``PreemptionRequested``;
+  * the driver's handler for it writes the round journal
+    (status="preempted"), drains the pipeline's scorer/prefetch threads
+    (the normal shutdown path — no orphans), finishes telemetry, and
+    re-raises; the CLI maps it to exit 0.  ``--resume_training`` then
+    reproduces the uninterrupted run's experiment_state bit-identically
+    (pinned by the SIGTERM subprocess test in tests/test_faults.py).
+
+Handlers install only on the main thread (signal.signal requires it;
+in-process test harnesses calling run_experiment from workers simply
+keep their own handlers) and the previous handlers are restored on
+uninstall, so a driver run never leaks its disposition into a host
+process (pytest, a notebook).
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Dict, Optional
+
+
+class PreemptionRequested(Exception):
+    """Raised at a safe point after SIGTERM/SIGINT; carries the signal
+    number.  The run's durable state is already consistent when this is
+    raised — resuming reproduces the uninterrupted run."""
+
+    def __init__(self, signum: int):
+        name = {signal.SIGTERM: "SIGTERM",
+                signal.SIGINT: "SIGINT"}.get(signum, str(signum))
+        super().__init__(f"preemption requested ({name}); state "
+                         "checkpointed for --resume_training")
+        self.signum = signum
+
+
+_STATE: Dict[str, Any] = {"signum": None, "logger": None}
+
+
+def _handler(signum, frame) -> None:  # pragma: no cover - exercised via kill
+    first = _STATE["signum"] is None
+    _STATE["signum"] = signum
+    logger = _STATE.get("logger")
+    if first and logger is not None:
+        try:
+            logger.warning(
+                "preemption signal received: checkpointing at the next "
+                "epoch/phase boundary, then exiting for --resume_training")
+        except Exception:  # noqa: BLE001 - inside a signal handler
+            pass
+
+
+def install(logger=None) -> Optional[Dict[int, Any]]:
+    """Install the SIGTERM/SIGINT recorders; returns the previous
+    handlers for ``uninstall``, or None when not on the main thread
+    (the host process keeps its own handling)."""
+    if threading.current_thread() is not threading.main_thread():
+        return None
+    _STATE["logger"] = logger
+    previous = {}
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            previous[signum] = signal.signal(signum, _handler)
+        except (ValueError, OSError):  # pragma: no cover - exotic hosts
+            pass
+    return previous
+
+
+def uninstall(previous: Optional[Dict[int, Any]]) -> None:
+    if not previous:
+        return
+    for signum, handler in previous.items():
+        try:
+            signal.signal(signum, handler)
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+
+
+def reset() -> None:
+    """Clear a recorded request (run start: a flag left by a previous
+    in-process run must not kill the new one)."""
+    _STATE["signum"] = None
+
+
+def requested() -> Optional[int]:
+    """The recorded signal number, or None."""
+    return _STATE["signum"]
+
+
+def check() -> None:
+    """Raise PreemptionRequested iff a signal was recorded — the one
+    spelling every safe point uses."""
+    signum = _STATE["signum"]
+    if signum is not None:
+        raise PreemptionRequested(signum)

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import faults
+
 DATA_AXIS = "data"
 
 
@@ -107,6 +109,9 @@ class DispatchGate:
         self._waits_lock = threading.Lock()
 
     def __enter__(self) -> "DispatchGate":
+        # Fault point BEFORE the acquire: an injected failure here never
+        # leaves the gate held (the `with` never entered).
+        faults.site("dispatch")
         # Uncontended (and reentrant-by-holder) acquires take the fast
         # path: no clock read, no wait recorded.
         if not self._lock.acquire(blocking=False):
@@ -223,6 +228,7 @@ def shard_rows(array: np.ndarray, mesh: Mesh,
     host overhead at one shard instead of one pool: a 10.5 GB factor
     matrix costs ~10.5/ndev GB of working copy, not a second 10.5 GB,
     and 10.5/ndev GB per chip once resident."""
+    faults.site("shard_upload")
     n = array.shape[0]
     total = n if rows is None else int(rows)
     if total < n:
@@ -231,6 +237,9 @@ def shard_rows(array: np.ndarray, mesh: Mesh,
     tail = array.shape[1:]
 
     def _shard(index):
+        # Per-shard fault point: one block's H2D can fail while its
+        # siblings succeed (the caller's RetryPolicy re-runs the upload).
+        faults.site("shard_upload", point="torn")
         rs = index[0]
         lo = rs.start or 0
         hi = total if rs.stop is None else rs.stop

@@ -50,7 +50,18 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from . import mesh as mesh_lib
+from .. import faults
 from ..utils.logging import get_logger
+
+# Device transfer is the classic transient-failure surface (HBM pressure
+# beside a live run, a tunneled backend hiccup): the once-per-experiment
+# pool upload retries under the ONE RetryPolicy instead of the ad-hoc
+# guards that used to live at each transfer site.  OOM is NOT retried —
+# re-uploading into the same full HBM fails the same way; the driver's
+# degradation ladder owns that case.
+_UPLOAD_RETRY = faults.RetryPolicy(site="h2d_upload",
+                                   classify=faults.classify_exception,
+                                   max_attempts=3)
 
 # The resident cache has CONCURRENT consumers since the pipelined round
 # (experiment/pipeline.py): the speculative scorer thread and the
@@ -243,24 +254,28 @@ def pool_arrays(cache: Dict, dataset: Any, mesh,
         n = len(dataset)
         key = (id(dataset.images), n)
         if key not in images:
-            if sharding == "row" and mesh.devices.size > 1 \
-                    and not mesh_lib.is_multiprocess(mesh):
-                # No ascontiguousarray here: shard_rows slices per shard
-                # (and makes each block contiguous itself), so the one
-                # big host copy the replicated path pays is exactly what
-                # the row path avoids.
-                images[key] = (
-                    dataset,
-                    mesh_lib.shard_rows(dataset.images[:n], mesh),
-                    mesh_lib.shard_rows(
-                        dataset.targets[:n].astype(np.int32), mesh))
-            else:
-                images[key] = (
+
+            def _upload():
+                faults.site("h2d_upload")
+                if sharding == "row" and mesh.devices.size > 1 \
+                        and not mesh_lib.is_multiprocess(mesh):
+                    # No ascontiguousarray here: shard_rows slices per
+                    # shard (and makes each block contiguous itself), so
+                    # the one big host copy the replicated path pays is
+                    # exactly what the row path avoids.
+                    return (
+                        dataset,
+                        mesh_lib.shard_rows(dataset.images[:n], mesh),
+                        mesh_lib.shard_rows(
+                            dataset.targets[:n].astype(np.int32), mesh))
+                return (
                     dataset,
                     mesh_lib.replicate(
                         np.ascontiguousarray(dataset.images[:n]), mesh),
                     mesh_lib.replicate(
                         dataset.targets[:n].astype(np.int32), mesh))
+
+            images[key] = _UPLOAD_RETRY.call(_upload)
         lru = cache.setdefault("lru", [])
         if key in lru:
             lru.remove(key)

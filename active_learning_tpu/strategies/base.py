@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from .. import faults
 from ..config import ExperimentConfig, TrainConfig
 from ..data.core import Dataset
 from ..pool import PoolState
@@ -42,6 +43,15 @@ from ..train.trainer import Trainer, TrainState
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsSink, NullSink
 from . import scoring
+
+# Pool scoring is stateless (consumes no rng, reads frozen weights), so
+# a whole-pass retry after a transient failure — a dead prefetch feeder
+# thread, an injected feed_worker fault, a flaky H2D — reproduces the
+# same scores bit for bit.  One retry: a pass that fails twice is not
+# transient; the driver's degradation ladder takes over.
+_SCORE_RETRY = faults.RetryPolicy(site="pool_score",
+                                  classify=faults.classify_exception,
+                                  max_attempts=2)
 
 
 class Strategy:
@@ -390,7 +400,8 @@ class Strategy:
                 return out
         loader = self.train_cfg.loader_te
         t0 = time.perf_counter()
-        out = scoring.collect_pool(
+        out = _SCORE_RETRY.call(
+            scoring.collect_pool,
             self.al_set, idxs, bs,
             self._get_score_step(kind), self.state.variables, self.mesh,
             num_workers=loader.num_workers, prefetch=loader.prefetch,

@@ -157,10 +157,18 @@ class StallWatchdog:
     """Daemon thread that fires ``on_stall(stalled_s)`` when the
     heartbeat's progress counter freezes past ``deadline_s``.
 
-    One callback per stall episode: after firing it re-arms only once
-    progress resumes, so a wedged collective logs one loud event, not
-    one per poll.  ``check(now)`` is the whole decision function —
-    public so tests drive it with a fake clock instead of sleeping.
+    One callback per DEADLINE WINDOW: firing opens a new window, so a
+    wedged collective logs one loud event per deadline — not one per
+    poll, and (the fixed re-arm edge) not exactly-once-forever either.
+    The old rule re-armed only when progress resumed, so a stall that
+    NEVER resumed — the same phase, frozen for hours — fired exactly
+    once and went quiet, which with ``--watchdog_action degrade`` would
+    mean exactly one escalation attempt no matter how wedged the run
+    was.  Now each full deadline of continued stall fires another
+    episode (``stalled_s`` reports the TOTAL stall, not the window), and
+    progress resuming resets everything.  ``check(now)`` is the whole
+    decision function — public so tests drive it with a fake clock
+    instead of sleeping.
     """
 
     def __init__(self, heartbeat: HeartbeatWriter, deadline_s: float,
@@ -177,7 +185,7 @@ class StallWatchdog:
         self._thread: Optional[threading.Thread] = None
         self._last_progress = heartbeat.progress
         self._last_change = monotonic_fn()
-        self._fired = False
+        self._last_fire: Optional[float] = None
         self.stalls_detected = 0
 
     def check(self, now: Optional[float] = None) -> bool:
@@ -187,11 +195,13 @@ class StallWatchdog:
         if progress != self._last_progress:
             self._last_progress = progress
             self._last_change = now
-            self._fired = False
+            self._last_fire = None
             return False
         stalled_s = now - self._last_change
-        if stalled_s > self.deadline_s and not self._fired:
-            self._fired = True
+        window_start = (self._last_fire if self._last_fire is not None
+                        else self._last_change)
+        if now - window_start > self.deadline_s:
+            self._last_fire = now
             self.stalls_detected += 1
             try:
                 self.on_stall(stalled_s)

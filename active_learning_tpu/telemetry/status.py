@@ -13,9 +13,18 @@ accelerator.
 Staleness is judged from the heartbeat file's MTIME against the
 deadline the run embedded in it (``stall_deadline_s``; ``--stale_after``
 overrides) — the same contract an external liveness probe would use.
+The round journal (``round_journal.json``, faults/journal.py) rides
+along when present: round/phase/attempt, the labeled-set digest, and
+the active degradation rungs.
 
 Exit codes: 0 = alive (or finished), 2 = no heartbeat found,
-3 = stale heartbeat.  ``--json`` emits the machine-readable summary.
+3 = stale heartbeat.  With ``--strict`` (the orchestrator contract,
+documented in README): 0 = healthy, 2 = no heartbeat, 3 = stale
+(staleness beats degradation — no progress is the worse state), 4 =
+alive but DEGRADED-MODE-ACTIVE (the journal's ``degrade`` list is
+non-empty: the run is making progress on a ladder rung — replicated
+pool, host feed, halved batch — and capacity planning should know).
+``--json`` emits the machine-readable summary either way.
 """
 
 from __future__ import annotations
@@ -27,6 +36,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+from ..faults.journal import JOURNAL_FILE, read_journal
 from . import heartbeat as hb_lib
 
 # How much of metrics.jsonl's tail to scan: enough for several rounds of
@@ -46,6 +56,10 @@ def get_parser() -> argparse.ArgumentParser:
                         "heartbeat's embedded stall_deadline_s)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
+    p.add_argument("--strict", action="store_true",
+                   help="orchestrator exit-code contract: 0 healthy / "
+                        "2 no heartbeat / 3 stale / 4 degraded-mode-"
+                        "active (from round_journal.json)")
     return p
 
 
@@ -102,7 +116,7 @@ def summarize(log_dir: str, stale_after: Optional[float] = None,
         age = hb_lib.heartbeat_age_s(path, now=now)
         deadline = (stale_after if stale_after is not None
                     else float(hb.get("stall_deadline_s", 600.0)))
-        finished = hb.get("status") in ("finished", "crashed")
+        finished = hb.get("status") in ("finished", "crashed", "preempted")
         stale = (age is not None and age > deadline and not finished)
         any_stale = any_stale or stale
         heartbeats.append({
@@ -112,6 +126,7 @@ def summarize(log_dir: str, stale_after: Optional[float] = None,
             "stale": stale,
             **{k: hb.get(k) for k in ("status", "round", "phase", "epoch",
                                       "step", "spec_phase", "spec_chunk",
+                                      "fault_last_site", "degrade",
                                       "process_index", "pid", "progress")},
         })
     events = read_metrics_tail(log_dir)
@@ -120,13 +135,24 @@ def summarize(log_dir: str, stale_after: Optional[float] = None,
         "step_time_ms_p99", "imgs_per_sec", "pool_rows_per_sec",
         "train_loss_ema", "grad_norm_ema", "hbm_peak_gb",
         "jit_cache_miss_delta", "stall_suspected",
+        "fault_retries_total", "degrade_events",
         "rd_query_time", "rd_train_time", "rd_test_time",
         "overlap_frac", "round_vs_max_phase", "spec_hit_frac",
     ])
     state = ("no-heartbeat" if not heartbeats
              else "stale" if any_stale else "ok")
+    # The round journal (WHERE the run is, and in what mode — see
+    # faults/journal.py): the degraded flag drives --strict's exit 4.
+    # A terminal status — including a CLEAN preemption — is history, not
+    # live capacity loss: exit 4 is for runs still making progress on a
+    # rung, never for one that already checkpointed-and-exited.
+    journal = read_journal(os.path.join(log_dir, JOURNAL_FILE))
+    degraded = bool(journal and journal.get("degrade")
+                    and journal.get("status") not in ("finished",
+                                                      "crashed",
+                                                      "preempted"))
     return {"log_dir": log_dir, "state": state, "heartbeats": heartbeats,
-            "metrics": metrics}
+            "journal": journal, "degraded": degraded, "metrics": metrics}
 
 
 def render_text(summary: Dict[str, Any]) -> str:
@@ -149,6 +175,16 @@ def render_text(summary: Dict[str, Any]) -> str:
     if not summary["heartbeats"]:
         lines.append("  (no heartbeat*.json — run not started, telemetry "
                      "off, or wrong --log_dir)")
+    jr = summary.get("journal")
+    if jr:
+        where = " ".join(f"{k}={jr[k]}" for k in
+                         ("status", "round", "phase", "attempt", "labeled")
+                         if jr.get(k) is not None)
+        lines.append(f"  journal: {where}  (seq {jr.get('seq')})")
+        if jr.get("degrade"):
+            lines.append("  DEGRADED: active ladder rungs "
+                         f"{jr['degrade']} (reverts at the next round "
+                         "boundary)")
     m = summary["metrics"]
     if m:
         lines.append("  latest metrics:")
@@ -157,6 +193,7 @@ def render_text(summary: Dict[str, Any]) -> str:
                      "step_time_ms_p99", "pool_rows_per_sec",
                      "train_loss_ema", "grad_norm_ema", "hbm_peak_gb",
                      "jit_cache_miss_delta", "stall_suspected",
+                     "fault_retries_total", "degrade_events",
                      "rd_query_time", "rd_train_time", "rd_test_time",
                      "overlap_frac", "round_vs_max_phase",
                      "spec_hit_frac"):
@@ -181,6 +218,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if summary["state"] == "stale":
         return 3
+    if args.strict and summary.get("degraded"):
+        # Alive but running on a degradation-ladder rung: distinct from
+        # both healthy (0) and stale (3) so orchestrators can alert on
+        # capacity loss without killing a self-healing run.
+        return 4
     return 0
 
 

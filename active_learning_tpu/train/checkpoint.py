@@ -23,6 +23,8 @@ import jax
 import numpy as np
 from flax import serialization
 
+from .. import faults
+
 # Bumped whenever saved model weights stop being interchangeable across
 # code versions even though their SHAPES still match — e.g. the conv
 # padding fix (models/resnet.py: strided 3x3 convs moved from XLA-SAME to
@@ -39,6 +41,7 @@ def save_variables(path: str, variables: Dict[str, Any]) -> None:
     """Atomic write (tmp + rename): a reader never sees a half-written
     checkpoint — mid-round resume (experiment/resume.py) and non-writer
     pod processes both read these files."""
+    faults.site("ckpt_write")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     host_vars = jax.tree.map(np.asarray, variables)
     tmp = f"{path}.tmp"
@@ -125,6 +128,11 @@ def publish_best(path: str, variables: Dict[str, Any], *, round_idx: int,
     """Atomically publish a best checkpoint plus its monotonic
     (round, epoch) tag — the writer side of the best-ckpt bus."""
     save_variables(path, variables)
+    # Torn point between the pair's two renames: a crash here leaves
+    # weights WITHOUT their tag — exactly the partial publish the
+    # watcher's legacy/tag-mismatch rules must absorb (chaos-tested via
+    # ckpt_write:torn@N).
+    faults.site("ckpt_write", point="torn")
     tag = {"round": int(round_idx), "epoch": int(epoch)}
     tmp = f"{path}.tag.json.tmp"
     with open(tmp, "w") as fh:
@@ -234,6 +242,7 @@ def save_fit_state(path: str, *, variables: Dict[str, Any], opt_state: Any,
                    step: Any, epoch: int, round_idx: int, best_perf: float,
                    best_epoch: int, es_count: int, key: Any,
                    rng: np.random.Generator) -> None:
+    faults.site("ckpt_write")
     trees = {
         "variables": serialization.to_state_dict(
             jax.tree.map(np.asarray, variables)),
@@ -244,6 +253,9 @@ def save_fit_state(path: str, *, variables: Dict[str, Any], opt_state: Any,
     with open(path + ".msgpack.tmp", "wb") as fh:
         fh.write(serialization.msgpack_serialize(trees))
     os.replace(path + ".msgpack.tmp", path + ".msgpack")
+    # Torn point between the pair's renames: trees without counters — the
+    # stamp cross-check in load_fit_state reads it as nothing-to-resume.
+    faults.site("ckpt_write", point="torn")
     meta = {
         "epoch": int(epoch),
         "round_idx": int(round_idx),

@@ -34,8 +34,10 @@ import numpy as np
 import optax
 from flax import serialization, struct
 
+from .. import faults
 from ..config import TrainConfig
 from ..data.augment import apply_view
+from ..faults import preempt as preempt_lib
 from ..telemetry import runtime as tele_runtime
 from ..telemetry import spans as tele_spans
 from ..data.core import Dataset
@@ -47,6 +49,16 @@ from ..utils.logging import get_logger
 from . import checkpoint as ckpt_lib
 from .evaluation import accumulate_metrics, make_eval_step
 from .optim import make_lr_schedule, make_optimizer
+
+
+# Checkpoint IO under the ONE retry policy (DESIGN.md §10): a transient
+# write failure (full-for-a-moment disk, NFS hiccup, injected
+# ckpt_write fault) retries with backoff instead of killing the fit —
+# every write here is atomic (tmp + rename), so a retried call simply
+# re-runs the whole publish and the pair lands consistent.
+_CKPT_RETRY = faults.RetryPolicy(site="ckpt_write",
+                                 classify=faults.classify_exception,
+                                 max_attempts=3)
 
 
 class TrainState(struct.PyTreeNode):
@@ -134,6 +146,11 @@ class Trainer:
         from ..parallel import resident as resident_lib
         self.resident_budget = resident_lib.resolve_budget(
             train_cfg.resident_scoring_bytes)
+        # True while the degradation ladder's feed_host rung holds the
+        # budget at 0: the round-start AUTO refresh must not quietly
+        # re-admit the resident path mid-degraded-round (set via
+        # set_resident_budget(pin=True); relax() unpins).
+        self._budget_pinned = False
         # Resident-pool LAYOUT, resolved ONCE for the experiment
         # (DESIGN.md §2b): "row" shards pool rows over the mesh's data
         # axis (per-chip residency = rows/ndev), "replicated" pins one
@@ -172,18 +189,21 @@ class Trainer:
         a resumed run with a smaller --resident_scoring_bytes, or an
         in-process set_resident_budget)."""
         from ..parallel import resident as resident_lib
-        if self.cfg.resident_scoring_bytes is None:
+        if self._budget_pinned or self.cfg.resident_scoring_bytes is not None:
+            # Pinned (the ladder's feed_host rung) or explicit: enforce
+            # the held budget instead of re-auto-sizing — a degraded
+            # round attempt must actually run degraded.
+            resident_lib.enforce_budget(self.resident_pool,
+                                        self.resident_budget)
+        else:
             # Pass the cache: pinned pools sit inside bytes_in_use, so
             # the headroom-derived budget must add them back to stay a
             # TOTAL cap under the shared eligible() accounting.
             self.resident_budget = resident_lib.resolve_budget(
                 None, cache=self.resident_pool)
-        else:
-            resident_lib.enforce_budget(self.resident_pool,
-                                        self.resident_budget)
         return self.resident_budget
 
-    def set_resident_budget(self, budget: int) -> list:
+    def set_resident_budget(self, budget: int, pin: bool = False) -> list:
         """Shrink (or grow) the resident budget mid-run: the new budget
         is enforced immediately — pinned pools over it demote LRU-first
         and every consumer (scoring, evaluation, the resident-gather
@@ -192,9 +212,12 @@ class Trainer:
         back to its host path at the next call, without a batch-shape
         change or a recompile.  Only an EXPLICIT device_resident=True
         keeps the copy-scan path regardless (the operator forced it).
-        Returns the demoted cache keys."""
+        Returns the demoted cache keys.  ``pin=True`` (the degradation
+        ladder) additionally holds the value across the round-start AUTO
+        refresh; the default unpins."""
         from ..parallel import resident as resident_lib
         self.resident_budget = int(budget)
+        self._budget_pinned = bool(pin)
         return resident_lib.enforce_budget(self.resident_pool,
                                            self.resident_budget)
 
@@ -1166,14 +1189,16 @@ class Trainer:
                         # publish_best = atomic write + monotonic
                         # (round, best_epoch) tag for the concurrent
                         # readers (serve hot-reload, speculative scorer).
-                        ckpt_lib.publish_best(
+                        _CKPT_RETRY.call(
+                            ckpt_lib.publish_best,
                             weight_paths["best_ckpt"],
                             jax.tree.map(np.asarray, best_variables),
                             round_idx=round_idx, epoch=best_epoch)
                         best_dirty = False
-                    ckpt_lib.save_variables(weight_paths["current_ckpt"],
-                                            jax.tree.map(np.asarray,
-                                                         state.variables))
+                    _CKPT_RETRY.call(
+                        ckpt_lib.save_variables,
+                        weight_paths["current_ckpt"],
+                        jax.tree.map(np.asarray, state.variables))
             if collect:
                 # AFTER validation on purpose: on the epoch-scan path the
                 # eval-accuracy fetch above is the sync that makes the
@@ -1195,29 +1220,50 @@ class Trainer:
                 # uninterrupted run stopped.
                 self.logger.info("Early stopping criterion reached. ")
                 break
+            preempted = preempt_lib.requested() is not None
             if (weight_paths and batch_hook is None
                     and mesh_lib.is_coordinator()
-                    and epoch % self.current_ckpt_every == 0
+                    and (epoch % self.current_ckpt_every == 0 or preempted)
                     and epoch < n_epoch):
-                ckpt_lib.save_fit_state(
+                if preempted and best_dirty:
+                    # The fit state about to be saved references
+                    # best_epoch; without this publish the resumed fit
+                    # would find best_ckpt missing and restart best-model
+                    # tracking — diverging from the uninterrupted run.
+                    _CKPT_RETRY.call(
+                        ckpt_lib.publish_best, weight_paths["best_ckpt"],
+                        jax.tree.map(np.asarray, best_variables),
+                        round_idx=round_idx, epoch=best_epoch)
+                    best_dirty = False
+                _CKPT_RETRY.call(
+                    ckpt_lib.save_fit_state,
                     weight_paths["fit_state"], variables=state.variables,
                     opt_state=state.opt_state, step=state.step, epoch=epoch,
                     round_idx=round_idx, best_perf=best_perf,
                     best_epoch=best_epoch, es_count=es_count, key=key,
                     rng=rng)
+            if preempted:
+                # Preemption (SIGTERM/SIGINT recorded by the driver's
+                # handler): the epoch boundary is the safe point — the
+                # fit state just saved (or the round-granular experiment
+                # state, when this was the final epoch) resumes
+                # bit-identically.  Raised AFTER the early-stop break
+                # above, so a state past patience still never persists.
+                preempt_lib.check()
 
         if best_variables is None:
             best_epoch = epochs_run
             best_variables = jax.tree.map(np.asarray, state.variables)
             best_dirty = True
         if best_dirty and weight_paths and mesh_lib.is_coordinator():
-            ckpt_lib.publish_best(weight_paths["best_ckpt"],
-                                  jax.tree.map(np.asarray, best_variables),
-                                  round_idx=round_idx, epoch=best_epoch)
+            _CKPT_RETRY.call(ckpt_lib.publish_best,
+                             weight_paths["best_ckpt"],
+                             jax.tree.map(np.asarray, best_variables),
+                             round_idx=round_idx, epoch=best_epoch)
         if weight_paths and mesh_lib.is_coordinator():
-            ckpt_lib.save_variables(weight_paths["current_ckpt"],
-                                    jax.tree.map(np.asarray,
-                                                 state.variables))
+            _CKPT_RETRY.call(ckpt_lib.save_variables,
+                             weight_paths["current_ckpt"],
+                             jax.tree.map(np.asarray, state.variables))
             # The round completed: a later restart must re-run it from
             # scratch (the experiment-level resume owns cross-round state).
             ckpt_lib.delete_fit_state(weight_paths["fit_state"])

@@ -189,15 +189,17 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # Hard bound on the ONE stdout line: the consuming harness records a
 # ~2,000-byte tail of stdout — which carries nothing but this line — so
 # the bound needs enough margin for tail-window slop, not another whole
-# line.  1780 leaves 220 bytes of margin and fits the 13-phase
+# line.  1840 leaves 160 bytes of margin and fits the 13-phase
 # realistic-maximal rich form (every phase cached with every optional
 # rider: the feed-hierarchy fields, unit/backend on BOTH paper-scale
-# selection phases, the sharded-ceiling probe's pool_sharding tag, and
-# now pipeline/overlap on both end-to-end round phases — ISSUE 7 grew
-# the honest maximum by ~90 bytes) without truncation; staged
-# truncation in _compact_line still guards the pathological cases.
-# Pinned by unit tests at both extremes.
-MAX_LINE_BYTES = 1780
+# selection phases, the sharded-ceiling probe's pool_sharding tag,
+# pipeline/overlap on both end-to-end round phases — ISSUE 7, ~90
+# bytes — and now the failure-model counters retries/degraded on both
+# round phases — ISSUE 8, worst case '"retries":NN,"degraded":N,' x2 ≈
+# 50 bytes) without truncation; staged truncation in _compact_line
+# still guards the pathological cases.  Pinned by unit tests at both
+# extremes.
+MAX_LINE_BYTES = 1840
 
 
 def log(msg: str) -> None:
@@ -1399,6 +1401,12 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         return next((v for k, v, s in sink.metrics
                      if k == name and s == rd), None)
 
+    def run_total(name):
+        # The driver emits the failure-model counters CUMULATIVELY at
+        # each round boundary: the run total is the largest value seen.
+        vals = [v for k, v, s in sink.metrics if k == name]
+        return max(vals) if vals else None
+
     # The pipelined round's proof-of-overlap numbers, from the DRIVER'S
     # own telemetry stream (experiment/driver._emit_overlap_telemetry —
     # bench never times the loop a second time): the warm arming round's
@@ -1467,6 +1475,13 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         "overlap_frac": overlap,
         "round_vs_max_phase": vs_max,
         "spec_hit_frac": spec_hit,
+        # The failure model's self-healing counters (DESIGN.md §10),
+        # from the same driver stream: site-level retries absorbed and
+        # degradation-ladder escalations taken during the measured
+        # rounds — an end-to-end wall-clock claim is dishonest if the
+        # run quietly self-healed mid-measurement.
+        "fault_retries_total": run_total("fault_retries_total"),
+        "degrade_events": run_total("degrade_events"),
         "total_sec": round(total_sec, 1),
         "residency": residency,
         **_model_config_fields(strategy.model),
@@ -2228,8 +2243,16 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                          # ride only the end-to-end round phases (their
                          # SUBJECT since ISSUE 7); the full overlap
                          # breakdown stays in the evidence file.
+                         # ... plus the failure model's counters
+                         # (ISSUE 8): how many site-level retries the
+                         # run absorbed and how many degradation-ladder
+                         # escalations it took — an end-to-end round
+                         # number is dishonest without knowing it
+                         # self-healed.
                          *((("round_pipeline", "pipeline"),
-                            ("overlap_frac", "overlap"))
+                            ("overlap_frac", "overlap"),
+                            ("fault_retries_total", "retries"),
+                            ("degrade_events", "degraded"))
                            if name.startswith("al_round") else ())):
             if e.get(src) is not None and dst not in c:
                 c[dst] = e[src]

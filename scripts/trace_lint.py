@@ -51,6 +51,18 @@ DESIGN.md §2b):
      deliberately outside the lint's reach: it is the backend
      workaround, not the coordinator.)
 
+... and the failure model's CLOSED-REGISTRY invariant (fault injection,
+DESIGN.md §10):
+
+  8. Every ``faults.site()`` call site names a string-literal site that
+     is registered in ``faults/registry.py``'s ``SITES`` tuple, each
+     registered name appears there exactly once AND is wired at ≥1 call
+     site (a typo'd or orphaned site would make chaos coverage silently
+     vacuous), and every ``RetryPolicy(...)`` construction passes an
+     explicit ``classify=`` keyword — the "no bare ``except Exception:
+     retry``" rule: what a call site considers transient is always
+     written at the call site.
+
 Stdlib only; exits 0 clean / 1 with findings on stderr.
 """
 
@@ -101,9 +113,12 @@ PIPELINE = os.path.join(PKG, "experiment", "pipeline.py")
 # Mirror of experiment/pipeline.PIPELINE_COORDINATOR_FNS (kept in both
 # places so the lint works without importing jax): the coordinator tier
 # of the speculative scorer.  Each must exist; none may device-sync.
-PIPELINE_COORDINATOR_FNS = ("_worker", "_score_slice", "_score_chunk",
-                            "publish_best", "finalize", "consume")
+PIPELINE_COORDINATOR_FNS = ("_worker", "_worker_loop", "_score_slice",
+                            "_score_chunk", "publish_best", "finalize",
+                            "consume")
 _PIPELINE_SYNC_CALLS = {"block_until_ready", "device_get"}
+
+FAULTS_REGISTRY = os.path.join(PKG, "faults", "registry.py")
 
 
 def _py_files():
@@ -204,6 +219,117 @@ def check() -> list:
     # stream.
     problems.extend(check_pipeline_coordinator())
 
+    # 8. The fault-injection registry is closed, fully wired, and every
+    # retry call site classifies.
+    problems.extend(check_fault_sites())
+
+    return problems
+
+
+def _registered_fault_sites(registry_path: str, problems: list):
+    """Parse faults/registry.py's ``SITES`` tuple; duplicate names are a
+    finding (each site registered EXACTLY once)."""
+    rel = os.path.relpath(registry_path, REPO)
+    try:
+        with open(registry_path) as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError) as e:
+        problems.append(f"{rel}: unreadable for the fault-site check ({e})")
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SITES"
+                for t in node.targets):
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                break
+            names = []
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value,
+                                                                str):
+                    names.append(elt.value)
+                else:
+                    problems.append(
+                        f"{rel}:{elt.lineno}: SITES holds a non-literal "
+                        "entry — the registry must be statically "
+                        "checkable")
+            for name in set(names):
+                if names.count(name) > 1:
+                    problems.append(
+                        f"{rel}: site {name!r} registered more than once "
+                        "in SITES — each site is registered exactly once")
+            return names
+    problems.append(f"{rel}: SITES tuple not found — the fault-site "
+                    "registry has nothing to check against")
+    return None
+
+
+def check_fault_sites(files=None,
+                      registry_path: str = FAULTS_REGISTRY) -> list:
+    """The failure model's closed-registry invariant, statically
+    (check 8): every ``faults.site()``/``site()`` call names a
+    registered site as a string literal, every registered site is wired
+    at ≥1 call site (full-tree mode only — ``files`` given means a
+    negative-case unit test on a fragment), and every ``RetryPolicy``
+    construction passes ``classify=`` explicitly."""
+    problems = []
+    registered = _registered_fault_sites(registry_path, problems)
+    if registered is None:
+        return problems
+    full_tree = files is None
+    paths = list(_py_files()) if full_tree else list(files)
+    wired = set()
+    for path in paths:
+        if os.path.abspath(path) == os.path.abspath(registry_path):
+            continue  # the definition site, not a call site
+        rel = os.path.relpath(path, REPO)
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            problems.append(f"{rel}: unreadable for the fault-site "
+                            f"check ({e})")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_site = (
+                (isinstance(fn, ast.Attribute) and fn.attr == "site"
+                 and isinstance(fn.value, ast.Name)
+                 and fn.value.id == "faults")
+                or (isinstance(fn, ast.Name) and fn.id == "site"))
+            is_retry = ((isinstance(fn, ast.Attribute)
+                         and fn.attr == "RetryPolicy")
+                        or (isinstance(fn, ast.Name)
+                            and fn.id == "RetryPolicy"))
+            if is_site:
+                arg = node.args[0] if node.args else None
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    problems.append(
+                        f"{rel}:{node.lineno}: faults.site() with a "
+                        "non-literal site name — the closed registry "
+                        "cannot be checked")
+                elif arg.value not in registered:
+                    problems.append(
+                        f"{rel}:{node.lineno}: faults.site({arg.value!r}) "
+                        "names an unregistered site (registry: "
+                        "faults/registry.py SITES)")
+                else:
+                    wired.add(arg.value)
+            if is_retry and not any(kw.arg == "classify"
+                                    for kw in node.keywords):
+                problems.append(
+                    f"{rel}:{node.lineno}: RetryPolicy(...) without an "
+                    "explicit classify= — every retry call site states "
+                    "its transient-vs-fatal rule (no bare retries)")
+    if full_tree:
+        for name in registered:
+            if name not in wired:
+                problems.append(
+                    f"faults/registry.py: site {name!r} is registered "
+                    "but wired at no call site — chaos coverage for it "
+                    "is vacuous")
     return problems
 
 

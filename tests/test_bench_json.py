@@ -140,7 +140,8 @@ class TestDegradedModeLine:
                 round_sec_warm=22.0, round_sec_cold=80.0,
                 feed_source="resident", feed_stall_frac=0.01,
                 round_pipeline="speculative", overlap_frac=0.31,
-                round_vs_max_phase=1.18, spec_hit_frac=1.0),
+                round_vs_max_phase=1.18, spec_hit_frac=1.0,
+                fault_retries_total=2, degrade_events=1),
             # n_chips stays 1 (the cache rides only when the entry's
             # hardware matches the live 1-device CPU probe); the layout
             # tag is what's being plumbed here.
@@ -178,6 +179,10 @@ class TestDegradedModeLine:
         # frac) stays in the evidence file, off the bounded line.
         assert "round_vs_max_phase" not in rd
         assert "spec_hit_frac" not in rd
+        # The failure model's counters (ISSUE 8): how much self-healing
+        # the measured rounds absorbed rides the degraded-mode line too.
+        assert rd["retries"] == 2
+        assert rd["degraded"] == 1
         # The sharded-pool probe's layout attribution (ISSUE 6): a
         # row-sharded max-N claim is meaningless without the layout tag.
         assert out["phases"]["kcenter_select_maxn"][

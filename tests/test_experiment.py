@@ -798,6 +798,12 @@ class TestBenchEvidence:
             extra.update(round_sec_warm=123.45, round_sec_cold=456.78,
                          test_accuracy_rd1=0.8125,
                          feed_source="resident", feed_stall_frac=0.02,
+                         # The pipelined round's riders (ISSUE 7) and
+                         # the failure model's counters (ISSUE 8) both
+                         # ride every end-to-end round phase.
+                         round_pipeline="speculative", overlap_frac=0.389,
+                         round_vs_max_phase=1.18, spec_hit_frac=0.33,
+                         fault_retries_total=12, degrade_events=3,
                          phases_sec={"round0": {"train_time": 100.0}})
         if name.startswith("kcenter_select"):
             # Every selection phase now attributes its pool layout
@@ -838,6 +844,8 @@ class TestBenchEvidence:
         assert out["evidence"] == bench.EVIDENCE_PATH
         assert out["phases"]["resnet50_imagenet_train"]["ips"] == 100.0
         assert out["phases"]["al_round_cifar"]["warm_s"] == 123.45
+        assert out["phases"]["al_round_cifar"]["retries"] == 12
+        assert out["phases"]["al_round_cifar"]["degraded"] == 3
         assert out["phases"]["imagenet_datapath"]["warm_ips"] == 9000.1
         # The file carries what the line dropped.
         with open(bench.EVIDENCE_PATH) as fh:

@@ -1,0 +1,995 @@
+"""Chaos tests for the failure model (DESIGN.md §10): the deterministic
+fault-injection registry, the one RetryPolicy, the atomic round journal,
+the degradation ladder, driver preemption — and the acceptance pins:
+
+  * the CHAOS MATRIX: with a fault armed at every registered site (one
+    at a time — raise, torn-write, thread-death), a 2-round CPU-mesh
+    experiment either completes or resumes to experiment_state
+    BIT-IDENTICAL to the fault-free run, the fault verifiably FIRED,
+    and zero threads are orphaned;
+  * real-SIGTERM subprocess kill mid-pipelined-round -> --resume_training
+    reproduces the uninterrupted run's picks bit-exactly;
+  * disarmed fault sites add no measurable hot-path overhead (pinned
+    like the telemetry-off <50µs/step bound).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from helpers import TinyClassifier, tiny_train_config
+
+from active_learning_tpu import faults
+from active_learning_tpu.config import ExperimentConfig, TelemetryConfig
+from active_learning_tpu.data.synthetic import get_data_synthetic
+from active_learning_tpu.experiment.driver import run_experiment
+from active_learning_tpu.faults import journal as journal_lib
+from active_learning_tpu.faults import ladder as ladder_lib
+from active_learning_tpu.faults import preempt as preempt_lib
+from active_learning_tpu.faults.registry import _SiteState
+from active_learning_tpu.telemetry import heartbeat as hb_lib
+from active_learning_tpu.telemetry import status as status_lib
+from active_learning_tpu.utils.metrics import NullSink
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends disarmed (and with no recorded
+    preemption) — an armed registry leaking across tests would make
+    unrelated failures look like chaos."""
+    faults.configure(None)
+    preempt_lib.reset()
+    yield
+    faults.configure(None)
+    preempt_lib.reset()
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar
+# ---------------------------------------------------------------------------
+
+class TestSpecGrammar:
+    def test_full_spec_parses(self):
+        parsed = faults.parse_spec(
+            "h2d_upload:raise@3,ckpt_write:torn@1,spec_scorer:die@0.5,"
+            "dispatch:delay@0.05,feed_worker:oom")
+        assert parsed == {
+            "h2d_upload": ("raise", 3),
+            "ckpt_write": ("torn", 1),
+            "spec_scorer": ("die", 0.5),
+            "dispatch": ("delay", 0.05),
+            "feed_worker": ("oom", None),
+        }
+
+    @pytest.mark.parametrize("bad,msg", [
+        ("bogus_site:raise", "unknown site"),
+        ("h2d_upload:explode", "not one of"),
+        ("h2d_upload:raise@zero", "neither an int"),
+        ("h2d_upload:raise@0", "probability"),        # Nth-hit is 1-based
+        ("h2d_upload:raise@1.5", "probability"),      # probs live in (0,1)
+        ("h2d_upload", "expected site:action"),
+        ("h2d_upload:raise,h2d_upload:die", "twice"),
+    ])
+    def test_malformed_specs_fail_fast(self, bad, msg):
+        with pytest.raises(ValueError, match=msg):
+            faults.parse_spec(bad)
+
+    def test_every_registered_site_has_a_wired_home(self):
+        # The registry is CLOSED and fully wired — enforced statically
+        # by trace_lint check 8; this pins the registry contents so a
+        # rename shows up here too.
+        assert faults.SITES == ("h2d_upload", "ckpt_write", "spec_scorer",
+                                "feed_worker", "shard_upload", "dispatch")
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_disarmed_site_is_a_noop(self):
+        faults.site("h2d_upload")                    # nothing raises
+        assert faults.fault_counters() == {}
+        assert faults.active_spec() is None
+
+    def test_nth_hit_fires_exactly_once(self):
+        faults.configure("h2d_upload:raise@3")
+        faults.site("h2d_upload")
+        faults.site("h2d_upload")
+        with pytest.raises(faults.InjectedFault) as exc:
+            faults.site("h2d_upload")
+        assert exc.value.site == "h2d_upload"
+        for _ in range(5):                           # never again
+            faults.site("h2d_upload")
+        c = faults.fault_counters()["h2d_upload"]
+        assert c == {"hits": 8, "fires": 1}
+
+    def test_oom_carries_the_resource_exhausted_marker(self):
+        faults.configure("feed_worker:oom@1")
+        with pytest.raises(faults.InjectedOOM) as exc:
+            faults.site("feed_worker")
+        assert "RESOURCE_EXHAUSTED" in str(exc.value)
+
+    def test_die_is_a_base_exception(self):
+        faults.configure("spec_scorer:die@1")
+        with pytest.raises(faults.ThreadDeath):
+            try:
+                faults.site("spec_scorer")
+            except Exception:  # noqa: BLE001 - the point: this MUST NOT catch
+                pytest.fail("ThreadDeath was caught by `except Exception`")
+
+    def test_torn_fires_only_at_the_torn_point(self):
+        faults.configure("ckpt_write:torn@1")
+        faults.site("ckpt_write")                    # enter: no fire
+        faults.site("ckpt_write")
+        with pytest.raises(faults.InjectedFault):
+            faults.site("ckpt_write", point="torn")
+        # ... and enter-actions never fire at the torn point.
+        faults.configure("ckpt_write:raise@1")
+        faults.site("ckpt_write", point="torn")      # no fire
+
+    def test_probability_is_seed_replayable(self):
+        def pattern(seed):
+            st = _SiteState("spec_scorer", "die", 0.5, seed)
+            fired = []
+            for _ in range(64):
+                try:
+                    st.hit("enter")
+                    fired.append(False)
+                except faults.ThreadDeath:
+                    fired.append(True)
+            return fired
+
+        assert pattern(7) == pattern(7)              # replayable
+        assert any(pattern(7)) and not all(pattern(7))
+
+    def test_unarmed_sites_stay_silent_beside_armed_ones(self):
+        faults.configure("dispatch:delay@0.0")
+        faults.site("h2d_upload")                    # armed spec, other site
+        assert faults.fault_counters()["dispatch"]["hits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy + classification
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_classification_table(self):
+        cls = faults.classify_exception
+        assert cls(faults.InjectedOOM("x")) == faults.OOM
+        assert cls(RuntimeError("RESOURCE_EXHAUSTED: out of memory")) \
+            == faults.OOM
+        assert cls(faults.InjectedFault("x")) == faults.TRANSIENT
+        assert cls(faults.ThreadDeath("x")) == faults.TRANSIENT
+        assert cls(OSError("disk full")) == faults.TRANSIENT
+        assert cls(ValueError("a bug")) == faults.FATAL
+
+    def test_transient_retries_then_succeeds(self):
+        calls = []
+        before = faults.retry_counters()["total"]
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        policy = faults.RetryPolicy(site="t1", max_attempts=5,
+                                    base_delay_s=0.001,
+                                    classify=faults.classify_exception)
+        assert policy.call(flaky) == "ok"
+        assert len(calls) == 3
+        counters = faults.retry_counters()
+        assert counters["total"] - before == 2
+        assert counters["last_site"] == "t1"
+        assert counters["by_site"]["t1"] >= 2
+
+    def test_fatal_and_oom_never_retry(self):
+        for exc in (ValueError("bug"), faults.InjectedOOM("h2d_upload")):
+            calls = []
+
+            def once(exc=exc):
+                calls.append(1)
+                raise exc
+
+            policy = faults.RetryPolicy(site="t2", max_attempts=5,
+                                        base_delay_s=0.001,
+                                        classify=faults.classify_exception)
+            with pytest.raises(type(exc)):
+                policy.call(once)
+            assert len(calls) == 1
+
+    def test_attempt_budget_reraises_the_last_failure(self):
+        policy = faults.RetryPolicy(site="t3", max_attempts=3,
+                                    base_delay_s=0.001,
+                                    classify=faults.classify_exception)
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise OSError(f"attempt {len(calls)}")
+
+        with pytest.raises(OSError, match="attempt 3"):
+            policy.call(always)
+        assert len(calls) == 3
+
+    def test_wall_budget_bounds_the_retry_loop(self):
+        policy = faults.RetryPolicy(site="t4", max_attempts=10 ** 6,
+                                    base_delay_s=0.02, max_delay_s=0.02,
+                                    wall_budget_s=0.1,
+                                    classify=faults.classify_exception)
+        def always():
+            raise OSError("x")
+
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            policy.call(always)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_classify_is_required(self):
+        with pytest.raises(ValueError, match="classify is required"):
+            faults.RetryPolicy(site="t5", classify=None)
+
+
+# ---------------------------------------------------------------------------
+# Round journal
+# ---------------------------------------------------------------------------
+
+class TestRoundJournal:
+    def test_merge_write_and_read(self, tmp_path):
+        path = str(tmp_path / "round_journal.json")
+        j = journal_lib.RoundJournal(path)
+        j.write(status="running", round=0, phase="train", degrade=[])
+        j.write(phase="test")                        # merges over retained
+        got = journal_lib.read_journal(path)
+        assert got["status"] == "running" and got["round"] == 0
+        assert got["phase"] == "test"
+        assert got["seq"] == 2 and got["ts"] > 0
+
+    def test_none_deletes_a_field(self, tmp_path):
+        path = str(tmp_path / "round_journal.json")
+        j = journal_lib.RoundJournal(path)
+        j.write(stalled_s=12.0, status="stalled")
+        j.write(stalled_s=None, status="running")
+        got = journal_lib.read_journal(path)
+        assert "stalled_s" not in got and got["status"] == "running"
+
+    def test_seq_continues_across_instances(self, tmp_path):
+        path = str(tmp_path / "round_journal.json")
+        journal_lib.RoundJournal(path).write(round=0)
+        j2 = journal_lib.RoundJournal(path)
+        payload = j2.write(round=1)
+        assert payload["seq"] == 2                   # monotonic across restarts
+
+    def test_disabled_writes_nothing(self, tmp_path):
+        path = str(tmp_path / "round_journal.json")
+        assert journal_lib.RoundJournal(path, enabled=False).write(x=1) is None
+        assert not os.path.exists(path)
+
+    def test_unparseable_reads_as_none(self, tmp_path):
+        path = str(tmp_path / "round_journal.json")
+        path2 = str(tmp_path / "garbage.json")
+        open(path2, "w").write("{not json")
+        assert journal_lib.read_journal(path) is None      # missing
+        assert journal_lib.read_journal(path2) is None     # torn/garbage
+
+    def test_no_tmp_residue(self, tmp_path):
+        path = str(tmp_path / "round_journal.json")
+        journal_lib.RoundJournal(path).write(round=0)
+        assert os.listdir(tmp_path) == ["round_journal.json"]
+
+
+# ---------------------------------------------------------------------------
+# Preemption plumbing
+# ---------------------------------------------------------------------------
+
+class TestPreempt:
+    def test_record_check_reset(self):
+        preempt_lib.reset()
+        assert preempt_lib.requested() is None
+        preempt_lib.check()                          # no-op when clear
+        preempt_lib._handler(signal.SIGTERM, None)
+        assert preempt_lib.requested() == signal.SIGTERM
+        with pytest.raises(preempt_lib.PreemptionRequested) as exc:
+            preempt_lib.check()
+        assert exc.value.signum == signal.SIGTERM
+        assert "SIGTERM" in str(exc.value)
+        preempt_lib.reset()
+        preempt_lib.check()
+
+    def test_install_restores_previous_handlers(self):
+        before = signal.getsignal(signal.SIGTERM)
+        previous = preempt_lib.install()
+        assert signal.getsignal(signal.SIGTERM) is preempt_lib._handler
+        preempt_lib.uninstall(previous)
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_install_off_main_thread_is_refused(self):
+        out = {}
+        t = threading.Thread(target=lambda: out.update(
+            r=preempt_lib.install()))
+        t.start()
+        t.join()
+        assert out["r"] is None
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def _module_strategy():
+    from helpers import make_strategy
+    return make_strategy("MarginSampler", n_train=64, init_pool=8)
+
+
+class TestDegradationLadder:
+    @pytest.fixture
+    def ladder(self, _module_strategy):
+        lad = ladder_lib.DegradationLadder(_module_strategy)
+        yield lad
+        lad.relax()
+
+    def test_generic_failures_walk_the_rungs_in_order(self, ladder,
+                                                      _module_strategy):
+        strategy = _module_strategy
+        pipe_before = strategy.pipeline
+        budget_before = strategy.trainer.resident_budget
+        assert ladder.escalate(RuntimeError("x"), 0) == "pipeline_off"
+        assert strategy.pipeline is None
+        assert ladder.escalate(RuntimeError("x"), 0) == "pool_replicated"
+        assert strategy.trainer.pool_sharding == "replicated"
+        assert ladder.escalate(RuntimeError("x"), 0) == "feed_host"
+        assert strategy.trainer.resident_budget == 0
+        # batch_half is reserved for OOM: the generic walk ends here.
+        assert ladder.escalate(RuntimeError("x"), 0) is None
+        assert ladder.events == 3
+        # relax reverts everything at the round boundary.
+        assert set(ladder.relax(1)) == {"pipeline_off", "pool_replicated",
+                                        "feed_host"}
+        assert ladder.active == []
+        assert strategy.pipeline is pipe_before
+        assert strategy.trainer.resident_budget == budget_before
+
+    def test_oom_jumps_to_batch_half_and_reverts(self, ladder,
+                                                 _module_strategy):
+        strategy = _module_strategy
+        bs = strategy.train_cfg.loader_tr.batch_size
+        assert ladder.escalate(faults.InjectedOOM("h2d_upload"), 0) \
+            == "batch_half"
+        assert strategy.trainer.cfg.loader_tr.batch_size == bs // 2
+        ladder.relax(1)
+        assert strategy.trainer.cfg.loader_tr.batch_size == bs
+
+    def test_oom_at_the_batch_floor_falls_through_to_hbm_rungs(
+            self, ladder, _module_strategy):
+        """An OOM with the batch already at the device floor must not
+        dead-end the ladder: the HBM-freeing rungs (feed_host, then
+        pipeline_off — never pool_replicated, which costs MORE per
+        chip) still get their shot before the run crashes."""
+        strategy = _module_strategy
+        floor = strategy.trainer.n_devices
+        saved = strategy.trainer.cfg
+        try:
+            strategy.trainer.cfg = dataclasses.replace(
+                saved, loader_tr=dataclasses.replace(
+                    saved.loader_tr, batch_size=floor))
+            oom = faults.InjectedOOM("h2d_upload")
+            assert ladder.escalate(oom, 0) == "feed_host"
+            assert ladder.escalate(oom, 0) == "pipeline_off"
+            assert ladder.escalate(oom, 0) is None  # exhausted, no repl.
+            assert "pool_replicated" not in ladder.active
+        finally:
+            strategy.trainer.cfg = saved
+
+    def test_site_provenance_picks_the_matching_rung(self, ladder):
+        exc = faults.InjectedFault("feed_worker")
+        assert ladder.escalate(exc, 0) == "feed_host"
+        ladder.relax(1)
+        exc = faults.InjectedFault("shard_upload")
+        assert ladder.escalate(exc, 0) == "pool_replicated"
+
+    def test_traceback_provenance_routes_real_failures(self, ladder):
+        """A REAL failure (no injected .site) is attributed by its
+        deepest in-subsystem traceback frame: a crash inside
+        parallel/mesh must reach pool_replicated first, not waste a
+        round attempt on pipeline_off."""
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        try:
+            mesh_lib.shard_rows(None, None)     # raises inside mesh.py
+        except Exception as exc:
+            assert ladder_lib._provenance_rung(exc) == "pool_replicated"
+            assert ladder.escalate(exc, 0) == "pool_replicated"
+
+    def test_feed_host_rung_survives_the_auto_budget_refresh(
+            self, ladder, _module_strategy):
+        """The feed_host rung must actually run degraded: with the
+        default AUTO budget, the retried attempt's round-start refresh
+        must not quietly re-admit the resident path; relax unpins."""
+        trainer = _module_strategy.trainer
+        assert trainer.cfg.resident_scoring_bytes is None  # auto mode
+        assert ladder.escalate(faults.InjectedFault("feed_worker"), 0) \
+            == "feed_host"
+        assert trainer.refresh_resident_budget() == 0
+        ladder.relax(1)
+        assert trainer.refresh_resident_budget() > 0
+
+    def test_stall_request_raises_at_the_safe_point(self, ladder):
+        ladder.check_stall()                         # clear: no-op
+        ladder.request_stall()
+        with pytest.raises(ladder_lib.DegradeRequested):
+            ladder.check_stall()
+        ladder.check_stall()                         # consumed
+
+    def test_max_attempts_covers_every_rung(self, ladder):
+        assert ladder.max_attempts() == len(ladder_lib.RUNGS) + 1
+
+
+# ---------------------------------------------------------------------------
+# Torn-write semantics at the checkpoint layer
+# ---------------------------------------------------------------------------
+
+class TestTornWrites:
+    def test_torn_publish_leaves_a_readable_pair_after_retry(self,
+                                                             tmp_path):
+        from active_learning_tpu.train import checkpoint as ckpt_lib
+
+        path = str(tmp_path / "best_rd_0.msgpack")
+        variables = {"params": {"w": np.ones((2, 2), np.float32)}}
+        faults.configure("ckpt_write:torn@1")
+        with pytest.raises(faults.InjectedFault):
+            ckpt_lib.publish_best(path, variables, round_idx=0, epoch=3)
+        # Weights landed, tag did not: the reader sees the legacy
+        # untagged form (absorbed by the watcher's rules), never a torn
+        # JSON.
+        assert os.path.exists(path)
+        assert ckpt_lib.read_best_tag(path) is None
+        # The retried publish (what _CKPT_RETRY does) lands the pair.
+        ckpt_lib.publish_best(path, variables, round_idx=0, epoch=3)
+        assert ckpt_lib.read_best_tag(path) == (0, 3)
+
+    def test_torn_save_experiment_reads_as_nothing_to_resume(
+            self, tmp_path, _module_strategy):
+        from active_learning_tpu.experiment import resume as resume_lib
+
+        strategy = _module_strategy
+        cfg = dataclasses.replace(
+            strategy.cfg, ckpt_path=str(tmp_path), exp_hash="torn")
+        faults.configure("ckpt_write:torn@1")
+        with pytest.raises(faults.InjectedFault):
+            resume_lib.save_experiment(strategy, cfg)
+        # State npz written, meta json not: meta-last ordering means the
+        # torn pair reads as NO saved experiment.
+        assert not resume_lib.has_saved_experiment(cfg)
+        faults.configure(None)
+        resume_lib.save_experiment(strategy, cfg)
+        assert resume_lib.has_saved_experiment(cfg)
+
+
+# ---------------------------------------------------------------------------
+# Disarmed overhead (the hot-path bound)
+# ---------------------------------------------------------------------------
+
+class TestDisarmedOverhead:
+    def test_disarmed_site_cost_is_negligible(self):
+        """Disarmed = one module-global read + identity compare.  Pinned
+        like the telemetry-off <50µs/step bound: 100k calls in well
+        under a second even on a loaded CI box (~2.5µs/call allowed;
+        the real cost is ~50ns)."""
+        n = 100_000
+        site = faults.site
+        t0 = time.perf_counter()
+        for _ in range(n):
+            site("dispatch")
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.25, f"{elapsed / n * 1e6:.2f}µs per disarmed site"
+
+
+# ---------------------------------------------------------------------------
+# The chaos matrix (e2e, the acceptance pin)
+# ---------------------------------------------------------------------------
+
+N_EPOCH = 3
+
+
+def _e2e_cfg(tag: str, root: str, *, resume: bool = False,
+             n_epoch: int = N_EPOCH, fault_spec=None) -> ExperimentConfig:
+    return ExperimentConfig(
+        dataset="synthetic", arg_pool="synthetic", strategy="MarginSampler",
+        rounds=2, round_budget=8, n_epoch=n_epoch,
+        early_stop_patience=n_epoch, run_seed=7, exp_hash=tag,
+        exp_name="faults", ckpt_path=os.path.join(root, "ckpt"),
+        log_dir=os.path.join(root, "logs"), round_pipeline="speculative",
+        resume_training=resume, fault_spec=fault_spec,
+        telemetry=TelemetryConfig(enabled=True, heartbeat_every_s=0.0))
+
+
+def _run_e2e(cfg: ExperimentConfig, data, host_feed: bool = False,
+             real_sink: bool = False):
+    train_cfg = tiny_train_config()
+    if host_feed:
+        # Force the host-streamed feed hierarchy: device_prefetch (the
+        # feed_worker site) only runs when the pool is NOT resident.
+        train_cfg = dataclasses.replace(train_cfg, resident_scoring_bytes=0)
+    run_experiment(cfg, sink=None if real_sink else NullSink(), data=data,
+                   train_cfg=train_cfg, model=TinyClassifier(num_classes=4))
+    state_path = glob.glob(os.path.join(
+        cfg.ckpt_path, "*", "experiment_state.npz"))[0]
+    return dict(np.load(state_path))
+
+
+def _metric_max(log_dir: str, name: str):
+    """Largest value of ``name`` in the run's metrics.jsonl (None when
+    never emitted)."""
+    best = None
+    path = os.path.join(log_dir, "metrics.jsonl")
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        for line in fh:
+            ev = json.loads(line)
+            if ev.get("kind") == "metric" and name in ev.get("metrics", {}):
+                v = ev["metrics"][name]
+                best = v if best is None else max(best, v)
+    return best
+
+
+@pytest.fixture(scope="module")
+def chaos_data():
+    return get_data_synthetic(n_train=96, n_test=32, num_classes=4,
+                              image_size=8, seed=5)
+
+
+@pytest.fixture(scope="module")
+def baseline(chaos_data, tmp_path_factory):
+    """The fault-free reference run every chaos scenario must reproduce
+    bit for bit."""
+    root = str(tmp_path_factory.mktemp("chaos_base"))
+    return _run_e2e(_e2e_cfg("base", root), chaos_data)
+
+
+@pytest.fixture(scope="module")
+def baseline_host_feed(chaos_data, tmp_path_factory):
+    """Fault-free reference over the host-streamed feed (the
+    feed_worker scenarios run there; same-config comparison isolates
+    the recovery claim from the PR 5 feed-equality contract)."""
+    root = str(tmp_path_factory.mktemp("chaos_base_host"))
+    return _run_e2e(_e2e_cfg("basehost", root), chaos_data,
+                    host_feed=True)
+
+
+# (spec, host_feed, signal): the matrix covers every registered site,
+# each action class at least once — raise (injected transient),
+# torn-write (both torn points), thread-death (scorer AND feeder
+# threads), plus a driver-thread failure deep enough to need the
+# round-attempt rollback (dispatch).  ``signal`` is how the recovery
+# must surface in the driver's OWN metrics stream: "retry" = a
+# site-level RetryPolicy absorbed it (fault_retries_total grew),
+# "heal" = retry OR a degradation-ladder round attempt (degrade_events),
+# None = the recovery is invisible to both counters by design (a failed
+# speculative chunk just costs a sequential recompute).
+CHAOS = [
+    ("h2d_upload:raise@1", False, "retry"),
+    ("h2d_upload:die@1", False, "retry"),     # ThreadDeath on the driver path
+    ("shard_upload:raise@2", False, "retry"), # per-shard torn point
+    ("ckpt_write:raise@2", False, "retry"),
+    ("ckpt_write:torn@1", False, "retry"),    # publish_best's torn pair
+    ("ckpt_write:torn@3", False, "retry"),
+    ("spec_scorer:raise@1", False, None),     # chunk fails -> sequential
+    ("spec_scorer:die@1", False, None),       # thread death harness
+    ("feed_worker:raise@1", True, "heal"),    # score retry or ladder round
+    ("feed_worker:die@1", True, "heal"),      # dead feeder thread
+    # Which consumer takes the Nth gate entry is thread-timing-
+    # dependent (trainer -> ladder round, collect_pool -> score retry,
+    # scorer chunk -> silent sequential fallback), so the dispatch
+    # scenario pins only the recovery, not which counter it rode.
+    ("dispatch:raise@5", False, None),
+]
+
+
+class TestChaosMatrix:
+    @pytest.mark.parametrize("spec,host_feed,signal", CHAOS,
+                             ids=[c[0] for c in CHAOS])
+    def test_run_completes_or_resumes_bit_identical(
+            self, spec, host_feed, signal, chaos_data, baseline,
+            baseline_host_feed, tmp_path):
+        reference = baseline_host_feed if host_feed else baseline
+        threads_before = set(threading.enumerate())
+        retries_before = faults.retry_counters()["total"]
+        tag = spec.replace(":", "_").replace("@", "_").replace(".", "p")
+        cfg = _e2e_cfg(tag, str(tmp_path))
+
+        faults.configure(spec, seed=cfg.run_seed)
+        try:
+            try:
+                state = _run_e2e(cfg, chaos_data, host_feed=host_feed,
+                                 real_sink=True)
+                mode = "completed"
+            except (Exception, faults.ThreadDeath):
+                # The armed run crashed (ladder exhausted or the fault
+                # outran every guard): resume fault-free — the durable
+                # state must carry the round.
+                fired = faults.fault_counters()[spec.split(":")[0]]["fires"]
+                assert fired >= 1
+                faults.configure(None)
+                state = _run_e2e(
+                    _e2e_cfg(tag, str(tmp_path), resume=True), chaos_data,
+                    host_feed=host_feed, real_sink=True)
+                mode = "resumed"
+            if mode == "completed":
+                fired = faults.fault_counters()[spec.split(":")[0]]["fires"]
+                assert fired >= 1, (
+                    f"{spec}: site never fired — the scenario is vacuous")
+        finally:
+            faults.configure(None)
+
+        # The recovery claim: bit-identical experiment_state.
+        assert set(state) == set(reference)
+        for k in reference:
+            assert np.array_equal(reference[k], state[k]), (
+                f"{spec} ({mode}): experiment_state[{k!r}] diverged")
+
+        # The recovery surfaces in the driver's own telemetry stream
+        # (what bench rides on the al_round phases).  fault_retries_
+        # total is emitted PER RUN (the driver subtracts its run-start
+        # baseline from the process counter), so >= 1 means a retry
+        # happened HERE — and the process counter must agree.
+        retried = (_metric_max(cfg.log_dir, "fault_retries_total")
+                   or 0) >= 1
+        degraded = (_metric_max(cfg.log_dir, "degrade_events") or 0) >= 1
+        if retried:
+            assert faults.retry_counters()["total"] > retries_before
+        if signal == "retry":
+            assert retried, f"{spec}: recovered without a recorded retry"
+        elif signal == "heal":
+            assert retried or degraded, (
+                f"{spec}: recovered with neither a retry nor a ladder "
+                "escalation on record")
+
+        # The journal records a clean finish.
+        jr = journal_lib.read_journal(
+            os.path.join(cfg.log_dir, faults.JOURNAL_FILE))
+        assert jr and jr["status"] == "finished"
+
+        # Zero orphaned threads (grace for daemon joins in flight).
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            extra = set(threading.enumerate()) - threads_before
+            if not extra:
+                break
+            time.sleep(0.05)
+        assert not extra, f"{spec}: orphaned threads {extra}"
+
+    def test_driver_arms_from_config_and_env_then_disarms(
+            self, chaos_data, tmp_path, monkeypatch):
+        """--fault_spec and $AL_FAULT_SPEC both reach the registry via
+        the driver (the CLI plumbs --fault_spec into the config), the
+        injected fault observably fires (per-run retry metric), and the
+        driver disarms ITS OWN arming on exit — a spec must never leak
+        into the next in-process run (bench phases, pytest)."""
+        cfg = _e2e_cfg("armcfg", str(tmp_path / "a"),
+                       fault_spec="ckpt_write:raise@2")
+        _run_e2e(cfg, chaos_data, real_sink=True)
+        assert (_metric_max(cfg.log_dir, "fault_retries_total") or 0) >= 1
+        assert faults.active_spec() is None  # disarmed at run exit
+        log = glob.glob(os.path.join(cfg.log_dir, "*.log"))[0]
+        assert "fault injection ARMED: ckpt_write:raise@2" in \
+            open(log).read()
+
+        monkeypatch.setenv("AL_FAULT_SPEC", "ckpt_write:raise@2")
+        cfg2 = _e2e_cfg("armenv", str(tmp_path / "b"))
+        _run_e2e(cfg2, chaos_data, real_sink=True)
+        assert (_metric_max(cfg2.log_dir, "fault_retries_total") or 0) >= 1
+        assert faults.active_spec() is None
+
+    def test_cli_plumbs_fault_flags(self):
+        from active_learning_tpu.experiment.cli import (args_to_config,
+                                                        get_parser)
+        args = get_parser().parse_args(
+            ["--dataset", "synthetic", "--strategy", "MarginSampler",
+             "--fault_spec", "h2d_upload:raise@3",
+             "--watchdog_action", "degrade"])
+        cfg = args_to_config(args)
+        assert cfg.fault_spec == "h2d_upload:raise@3"
+        assert cfg.telemetry.watchdog_action == "degrade"
+
+
+# ---------------------------------------------------------------------------
+# Preemption: checkpoint-and-exit, resume bit-identical
+# ---------------------------------------------------------------------------
+
+class _PreemptAtEpochSink(NullSink):
+    """Records a preemption request (exactly what the real signal
+    handler does) when round ``rd``'s fit reaches the given epoch — a
+    deterministic in-process stand-in for SIGTERM."""
+
+    def __init__(self, rd: int, epoch: int):
+        self.name = f"rd_{rd}_validation_accuracy"
+        self.epoch = epoch
+        self.fired = False
+
+    def log_metric(self, name, value, step=None):
+        if not self.fired and step == self.epoch and name == self.name:
+            self.fired = True
+            preempt_lib._handler(signal.SIGTERM, None)
+
+
+class TestPreemptionResume:
+    def test_round0_preemption_resumes_bit_identical(self, chaos_data,
+                                                     baseline, tmp_path):
+        """Preempted DURING round 0's fit (before any save_experiment):
+        the trainer saves the mid-fit state at the epoch boundary, the
+        journal records the preemption, and --resume_training replays
+        round 0 consuming that state — experiment_state bit-identical
+        to the uninterrupted run."""
+        cfg = _e2e_cfg("preempt0", str(tmp_path))
+        sink = _PreemptAtEpochSink(rd=0, epoch=1)
+        with pytest.raises(preempt_lib.PreemptionRequested):
+            run_experiment(cfg, sink=sink, data=chaos_data,
+                           train_cfg=tiny_train_config(),
+                           model=TinyClassifier(num_classes=4))
+        assert sink.fired
+        jr = journal_lib.read_journal(
+            os.path.join(cfg.log_dir, faults.JOURNAL_FILE))
+        assert jr["status"] == "preempted"
+        assert jr["signal"] == int(signal.SIGTERM)
+        # No experiment-level state yet — the journal is what makes
+        # this resumable.
+        assert not glob.glob(os.path.join(cfg.ckpt_path, "*",
+                                          "experiment_state.npz"))
+        state = _run_e2e(_e2e_cfg("preempt0", str(tmp_path), resume=True),
+                         chaos_data)
+        for k in baseline:
+            assert np.array_equal(baseline[k], state[k]), (
+                f"experiment_state[{k!r}] diverged after round-0 "
+                "preemption resume")
+
+    def test_round1_preemption_resumes_bit_identical(self, chaos_data,
+                                                     baseline, tmp_path):
+        """Preempted during round 1's fit: round 0's completed state
+        loads, round 1's mid-fit state is consumed."""
+        cfg = _e2e_cfg("preempt1", str(tmp_path))
+        sink = _PreemptAtEpochSink(rd=1, epoch=1)
+        with pytest.raises(preempt_lib.PreemptionRequested):
+            run_experiment(cfg, sink=sink, data=chaos_data,
+                           train_cfg=tiny_train_config(),
+                           model=TinyClassifier(num_classes=4))
+        assert sink.fired
+        state = _run_e2e(_e2e_cfg("preempt1", str(tmp_path), resume=True),
+                         chaos_data)
+        for k in baseline:
+            assert np.array_equal(baseline[k], state[k]), (
+                f"experiment_state[{k!r}] diverged after round-1 "
+                "preemption resume")
+
+    def test_resume_without_state_or_preemption_still_refuses(
+            self, chaos_data, tmp_path):
+        """The never-silently-restart contract survives: no saved
+        experiment AND no preemption journal -> explicit error."""
+        cfg = _e2e_cfg("norestart", str(tmp_path), resume=True)
+        with pytest.raises(FileNotFoundError, match="no saved experiment"):
+            run_experiment(cfg, sink=NullSink(), data=chaos_data,
+                           train_cfg=tiny_train_config(),
+                           model=TinyClassifier(num_classes=4))
+
+    def test_round0_resume_requires_matching_identity(self, chaos_data,
+                                                      tmp_path):
+        """The journal is keyed by log_dir, not experiment: a round-0
+        preemption must only unlock the resume for the SAME exp_name/
+        exp_hash — a forgotten --exp_hash (fresh uuid) or a wrong
+        --ckpt_path preempted at a later round still hits the explicit
+        error, never a silent restart."""
+        cfg = _e2e_cfg("ident0", str(tmp_path))
+        sink = _PreemptAtEpochSink(rd=0, epoch=1)
+        with pytest.raises(preempt_lib.PreemptionRequested):
+            run_experiment(cfg, sink=sink, data=chaos_data,
+                           train_cfg=tiny_train_config(),
+                           model=TinyClassifier(num_classes=4))
+        # Same dirs, DIFFERENT exp_hash (the forgotten-flag shape).
+        wrong = dataclasses.replace(
+            _e2e_cfg("ident0", str(tmp_path), resume=True),
+            exp_hash="other")
+        with pytest.raises(FileNotFoundError, match="no saved experiment"):
+            run_experiment(wrong, sink=NullSink(), data=chaos_data,
+                           train_cfg=tiny_train_config(),
+                           model=TinyClassifier(num_classes=4))
+        # A journal preempted at a LATER round never unlocks the
+        # round-0 path either, even with matching identity (wrong
+        # --ckpt_path shape: the completed rounds live elsewhere).
+        journal_lib.RoundJournal(
+            os.path.join(cfg.log_dir, faults.JOURNAL_FILE)).write(
+                exp_name="faults", exp_hash="ident0",
+                round=1, status="preempted")
+        with pytest.raises(FileNotFoundError, match="no saved experiment"):
+            run_experiment(
+                _e2e_cfg("ident0", str(tmp_path), resume=True),
+                sink=NullSink(), data=chaos_data,
+                train_cfg=tiny_train_config(),
+                model=TinyClassifier(num_classes=4))
+
+
+# ---------------------------------------------------------------------------
+# Real SIGTERM, real subprocess, mid-pipelined-round
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r}); sys.path.insert(0, {tests!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+from helpers import TinyClassifier, tiny_train_config
+from active_learning_tpu.config import ExperimentConfig, TelemetryConfig
+from active_learning_tpu.data.synthetic import get_data_synthetic
+from active_learning_tpu.experiment.driver import run_experiment
+from active_learning_tpu.faults.preempt import PreemptionRequested
+from active_learning_tpu.utils.metrics import NullSink
+
+cfg = ExperimentConfig(
+    dataset="synthetic", arg_pool="synthetic", strategy="MarginSampler",
+    rounds=2, round_budget=8, n_epoch={n_epoch}, early_stop_patience={n_epoch},
+    run_seed=7, exp_hash="sigterm", exp_name="faults",
+    ckpt_path={ckpt!r}, log_dir={log!r}, round_pipeline="speculative",
+    resume_training={resume}, fault_spec={fault_spec!r},
+    telemetry=TelemetryConfig(enabled=True, heartbeat_every_s=0.0))
+data = get_data_synthetic(n_train=96, n_test=32, num_classes=4,
+                          image_size=8, seed=5)
+print("CHILD_READY", flush=True)
+try:
+    run_experiment(cfg, sink=NullSink(), data=data,
+                   train_cfg=tiny_train_config(),
+                   model=TinyClassifier(num_classes=4))
+except PreemptionRequested:
+    # The CLI's mapping: graceful preemption exits 0.
+    print("CHILD_PREEMPTED", flush=True)
+    sys.exit(0)
+print("CHILD_FINISHED", flush=True)
+"""
+
+SIG_EPOCHS = 6
+
+
+def _spawn_child(ckpt: str, log: str, *, resume: bool = False,
+                 fault_spec=None):
+    code = _CHILD.format(repo=REPO,
+                         tests=os.path.join(REPO, "tests"),
+                         n_epoch=SIG_EPOCHS, ckpt=ckpt, log=log,
+                         resume=resume, fault_spec=fault_spec)
+    return subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+class TestSigtermSubprocess:
+    @pytest.fixture(scope="class")
+    def uninterrupted(self, chaos_data, tmp_path_factory):
+        """The SIGTERM comparison baseline at the subprocess config
+        (more epochs: the kill needs a fit long enough to land in)."""
+        root = str(tmp_path_factory.mktemp("sig_base"))
+        return _run_e2e(_e2e_cfg("sigbase", root, n_epoch=SIG_EPOCHS),
+                        chaos_data)
+
+    def test_sigterm_mid_round_resumes_bit_exact(self, uninterrupted,
+                                                 tmp_path):
+        """The acceptance pin, end to end in real processes: a driver
+        child (pipelined round armed, every dispatch stretched by the
+        delay fault so the kill window is wide) takes a REAL SIGTERM
+        mid-round-0-fit, exits 0 with everything checkpointed; a second
+        child resumes and finishes; the picks are bit-exact vs the
+        uninterrupted run."""
+        ckpt = str(tmp_path / "ckpt")
+        log = str(tmp_path / "logs")
+        proc = _spawn_child(ckpt, log, fault_spec="dispatch:delay@0.05")
+        try:
+            hb_path = os.path.join(log, "heartbeat.json")
+            deadline = time.monotonic() + 300
+            in_fit = False
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    pytest.fail("child exited before the kill: "
+                                + proc.communicate()[1][-2000:])
+                hb = hb_lib.read_heartbeat(hb_path) or {}
+                if (hb.get("round") == 0 and (hb.get("epoch") or 0) >= 1
+                        and hb.get("status") == "running"):
+                    in_fit = True
+                    break
+                time.sleep(0.02)
+            assert in_fit, "child never reached round 0's fit"
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=120)
+            assert proc.returncode == 0, err[-2000:]
+            assert "CHILD_PREEMPTED" in out
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+
+        jr = journal_lib.read_journal(
+            os.path.join(log, faults.JOURNAL_FILE))
+        assert jr["status"] == "preempted"
+        assert jr["signal"] == int(signal.SIGTERM)
+
+        resumed = _spawn_child(ckpt, log, resume=True)
+        try:
+            out, err = resumed.communicate(timeout=600)
+            assert resumed.returncode == 0, err[-2000:]
+            assert "CHILD_FINISHED" in out
+        finally:
+            if resumed.poll() is None:
+                resumed.kill()
+                resumed.communicate()
+
+        state_path = glob.glob(os.path.join(ckpt, "*",
+                                            "experiment_state.npz"))[0]
+        state = dict(np.load(state_path))
+        assert set(state) == set(uninterrupted)
+        for k in uninterrupted:
+            assert np.array_equal(uninterrupted[k], state[k]), (
+                f"experiment_state[{k!r}] diverged after SIGTERM resume")
+
+
+# ---------------------------------------------------------------------------
+# status --strict: the orchestrator exit-code contract
+# ---------------------------------------------------------------------------
+
+class TestStatusStrict:
+    def _fresh_dir(self, tmp_path, *, degrade=None, status="running"):
+        d = str(tmp_path)
+        os.makedirs(d, exist_ok=True)
+        hb = hb_lib.HeartbeatWriter(os.path.join(d, "heartbeat.json"),
+                                    every_s=0.0, stall_deadline_s=600.0)
+        hb.tick(round=1, phase="train", status="running")
+        j = journal_lib.RoundJournal(os.path.join(d, faults.JOURNAL_FILE))
+        j.write(status=status, round=1, phase="train",
+                degrade=degrade or [])
+        return d
+
+    def test_healthy_is_zero_with_and_without_strict(self, tmp_path):
+        d = self._fresh_dir(tmp_path)
+        assert status_lib.main(["--log_dir", d]) == 0
+        assert status_lib.main(["--log_dir", d, "--strict"]) == 0
+
+    def test_degraded_is_4_only_under_strict(self, tmp_path):
+        d = self._fresh_dir(tmp_path, degrade=["pool_replicated"])
+        assert status_lib.main(["--log_dir", d]) == 0
+        assert status_lib.main(["--log_dir", d, "--strict"]) == 4
+        text = status_lib.render_text(status_lib.summarize(d))
+        assert "DEGRADED" in text and "pool_replicated" in text
+
+    def test_stale_beats_degraded(self, tmp_path):
+        d = self._fresh_dir(tmp_path, degrade=["feed_host"])
+        hb_path = os.path.join(d, "heartbeat.json")
+        old = time.time() - 10_000.0
+        os.utime(hb_path, (old, old))
+        assert status_lib.main(["--log_dir", d, "--strict"]) == 3
+
+    def test_terminal_status_with_leftover_degrade_is_healthy(
+            self, tmp_path):
+        # A run that ended ON a rung — finished, or CLEANLY PREEMPTED
+        # mid-degraded-round — is done self-healing: exit 4 is for live
+        # capacity loss, not history (a false 4 after preemption would
+        # block resume automation).
+        for i, status in enumerate(("finished", "preempted")):
+            d = self._fresh_dir(tmp_path / str(i), degrade=["feed_host"],
+                                status=status)
+            assert status_lib.main(["--log_dir", d, "--strict"]) == 0, \
+                status
+
+    def test_no_heartbeat_is_2(self, tmp_path):
+        assert status_lib.main(["--log_dir", str(tmp_path),
+                                "--strict"]) == 2

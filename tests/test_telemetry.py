@@ -151,14 +151,17 @@ class TestHeartbeat:
         clock["t"] = 23.0                   # frozen 11s > 10s deadline
         assert wd.check() is True
         assert len(stalls) == 1 and stalls[0] > 10.0
-        clock["t"] = 40.0                   # STILL stalled: no re-fire
+        clock["t"] = 24.0                   # inside the fire's window
         assert wd.check() is False
-        hb.tick(round=1)                    # progress re-arms
-        clock["t"] = 41.0
+        clock["t"] = 40.0                   # STILL stalled one more full
+        assert wd.check() is True           # deadline: fires again (the
+        assert stalls[1] > 25.0             # fixed re-arm edge; reports
+        hb.tick(round=1)                    # the TOTAL stall), and
+        clock["t"] = 41.0                   # progress still re-arms
         assert wd.check() is False
         clock["t"] = 60.0
-        assert wd.check() is True           # second episode fires again
-        assert wd.stalls_detected == 2
+        assert wd.check() is True           # next episode fires again
+        assert wd.stalls_detected == 3
 
 
 class TestPrometheus:
@@ -601,6 +604,8 @@ class TestTraceLint:
             "import jax\n"
             "def _worker(self):\n"
             "    jax.block_until_ready(self.out)\n"
+            "def _worker_loop(self):\n"
+            "    pass\n"
             "def _score_slice(self, plan, sl, variables):\n"
             "    return jax.device_get(variables)\n"
             "def _score_chunk(self, plan, sl, tag, variables, i):\n"
@@ -639,6 +644,63 @@ class TestTraceLint:
         from active_learning_tpu.strategies import kcenter as kc
         assert set(kc.SHARDED_SELECTION_FNS) == set(
             lint.SHARDED_DEVICE_FNS + lint.SHARDED_ORCHESTRATOR_FNS)
+
+    def test_lint_flags_fault_site_violations(self, tmp_path):
+        """The failure model's closed-registry invariant (check 8,
+        DESIGN.md §10): an unregistered site name, a non-literal site
+        name, and a RetryPolicy without an explicit classify= must each
+        fail the lint; duplicate registration and a registered-but-
+        never-wired site are findings too."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_lint", os.path.join(REPO, "scripts", "trace_lint.py"))
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+
+        bad = tmp_path / "bad_sites.py"
+        bad.write_text(
+            "from active_learning_tpu import faults\n"
+            "def upload(name):\n"
+            "    faults.site('h2d_uplaod')\n"          # typo'd site
+            "    faults.site(name)\n"                  # non-literal
+            "    faults.site('ckpt_write')\n"          # fine
+            "    p = faults.RetryPolicy(site='x')\n"   # no classify=
+            "    q = faults.RetryPolicy(site='y', "
+            "classify=faults.classify_exception)\n")   # fine
+        problems = lint.check_fault_sites([str(bad)])
+        assert any("unregistered site" in p and "h2d_uplaod" in p
+                   for p in problems)
+        assert any("non-literal site name" in p for p in problems)
+        assert any("without an explicit classify=" in p for p in problems)
+        assert len(problems) == 3  # the two clean calls stay clean
+
+        # Duplicate registration is a finding against the registry.
+        dup_reg = tmp_path / "dup_registry.py"
+        dup_reg.write_text("SITES = ('a', 'b', 'a')\n")
+        problems = lint.check_fault_sites([str(bad)],
+                                          registry_path=str(dup_reg))
+        assert any("registered more than once" in p for p in problems)
+
+        # Full-tree mode: a registered site wired at no call site makes
+        # its chaos coverage vacuous.
+        lone = tmp_path / "lone_registry.py"
+        lone.write_text("SITES = ('never_wired',)\n")
+        orig = lint._py_files
+        try:
+            lint._py_files = lambda: [str(bad)]
+            problems = lint.check_fault_sites(
+                registry_path=str(lone))
+        finally:
+            lint._py_files = orig
+        assert any("never_wired" in p and "wired at no call site" in p
+                   for p in problems)
+
+        # The REAL tree is clean against the REAL registry, and the
+        # lint's view of the registry matches the package's.
+        assert lint.check_fault_sites() == []
+        from active_learning_tpu import faults
+        assert tuple(lint._registered_fault_sites(
+            lint.FAULTS_REGISTRY, [])) == tuple(faults.SITES)
 
 
 class TestSatelliteFixes:
