@@ -129,6 +129,29 @@ class TrainConfig:
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     pretrained: PretrainedConfig = dataclasses.field(default_factory=PretrainedConfig)
     imbalanced_training: bool = False
+    # Fused optimizer update (train/optim.FusedSGD, DESIGN.md §4): the
+    # SGD+momentum+weight-decay update as ONE tree-fused expression
+    # inside the donated train step instead of the optax chain's four
+    # tree traversals.  "auto" (default) = fused whenever the optimizer
+    # is SGD-family; "on" forces it (fails fast on non-SGD); "off"
+    # keeps the optax chain.  At f32 optimizer state the fused path is
+    # BIT-identical to optax (pinned in tests/test_backward.py) — this
+    # knob is throughput-only there.
+    fused_optimizer: str = "auto"
+    # Momentum-buffer storage dtype for the fused path: "f32" (default,
+    # bit-parity with optax) or "bf16" (HALF the optimizer HBM; buffers
+    # read bf16, accumulate f32, round once on store — bounded-delta,
+    # learn-tested).  Ignored on the optax path.
+    optim_state_dtype: str = "f32"
+    # Gradient all-reduce precision across the mesh (parallel/mesh.py,
+    # DESIGN.md §4): "f32" (default) is the partitioner's bit-exact
+    # psum; "int8" is the EQuARX-style block-scaled quantized sync —
+    # ~4x fewer wire bytes per gradient — with global-batch BN kept via
+    # explicit pmean'd statistics.  int8 is bounded-delta (never
+    # bit-exact), OFF on single-device meshes, and gated on the
+    # multichip learning probe at driver startup: a probe failure
+    # degrades the run to f32 loudly (journaled).
+    grad_allreduce: str = "f32"
     # Device-resident epochs for in-memory datasets (one jitted scan per
     # epoch instead of per-batch dispatch).  None = auto (on when the
     # images fit in HBM and the labeled set is large enough to amortize
@@ -397,6 +420,25 @@ class ExperimentConfig:
     # the feed hierarchy (resident-gather > prefetched-host >
     # serial-host); every feed is bit-identical at the same seeds.
     train_feed: Optional[str] = None
+
+    # Fused optimizer-update override ("auto"/"on"/"off"): None defers
+    # to the arg pool's TrainConfig.fused_optimizer.  Bit-identical to
+    # the optax chain at f32 optimizer state.
+    fused_optimizer: Optional[str] = None
+
+    # Momentum-buffer dtype override ("f32"/"bf16") for the fused
+    # optimizer path: None defers to the arg pool.  bf16 halves
+    # optimizer HBM (bounded-delta; f32 is bit-parity with optax).
+    optim_state_dtype: Optional[str] = None
+
+    # Gradient all-reduce precision override ("f32"/"int8"): None
+    # defers to the arg pool (default f32 = the bit-exact psum).  int8
+    # (EQuARX-style block-scaled quantized sync; wire win on 2-8 device
+    # meshes — see parallel/mesh.int8_allreduce) is bounded-delta,
+    # default-off, OFF on single-device meshes, and gated on the
+    # multichip learning probe at run start (a failed probe degrades to
+    # f32 loudly — journaled).
+    grad_allreduce: Optional[str] = None
 
     # Resident-pool layout override ("auto"/"replicated"/"row"): None
     # defers to the arg pool's TrainConfig.pool_sharding, whose default

@@ -152,6 +152,28 @@ def get_parser() -> argparse.ArgumentParser:
                    help="gather/decode worker threads for the host train "
                         "feed (the reference DataLoader's num_workers); "
                         "default defers to the arg pool's train loader")
+    p.add_argument("--fused_optimizer", type=str, default=None,
+                   choices=["auto", "on", "off"],
+                   help="fused SGD+momentum+weight-decay update inside "
+                        "the donated train step (one tree pass instead "
+                        "of the optax chain's four; bit-identical to "
+                        "optax at f32 state).  auto = on for SGD-family "
+                        "optimizers")
+    p.add_argument("--optim_state_dtype", type=str, default=None,
+                   choices=["f32", "bf16"],
+                   help="momentum-buffer dtype on the fused optimizer "
+                        "path: f32 (default, bit-parity with optax) or "
+                        "bf16 (half the optimizer HBM; read bf16, "
+                        "accumulate f32, bounded-delta)")
+    p.add_argument("--grad_allreduce", type=str, default=None,
+                   choices=["f32", "int8"],
+                   help="gradient all-reduce precision across the mesh: "
+                        "f32 (default, bit-exact psum) or int8 "
+                        "(EQuARX-style block-scaled quantized sync, int8 "
+                        "wire payload; bounded-delta, off on "
+                        "single-device meshes, gated on the multichip "
+                        "learning probe — a failed probe degrades the "
+                        "run to f32 loudly)")
     p.add_argument("--round_pipeline", type=str, default="auto",
                    choices=["auto", "off", "speculative"],
                    help="pipelined AL round: speculative overlaps the "
@@ -241,6 +263,9 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         train_feed=args.train_feed,
         pool_sharding=args.pool_sharding,
         feed_workers=args.feed_workers,
+        fused_optimizer=args.fused_optimizer,
+        optim_state_dtype=args.optim_state_dtype,
+        grad_allreduce=args.grad_allreduce,
         round_pipeline=args.round_pipeline,
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,

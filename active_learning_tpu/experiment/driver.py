@@ -123,6 +123,94 @@ def enable_compilation_cache(cache_dir: Optional[str] = None
     return cache_dir
 
 
+# The int8 gradient sync's pinned accuracy-delta bound: the probe model
+# trained through the quantized step must land within this much test
+# accuracy of its bit-exact f32 twin (same seeds, same data) or the run
+# degrades to f32.  Pinned by tests/test_backward.py.
+INT8_PROBE_MAX_ACC_DELTA = 0.05
+
+
+def run_grad_allreduce_probe(mesh) -> Tuple[bool, Optional[float]]:
+    """The multichip learning probe gating ``--grad_allreduce int8``
+    (DESIGN.md §4): train one tiny probe model twice over the live mesh
+    — once through the bit-exact f32 step, once through the int8
+    quantized-sync step, same seeds — and compare test accuracy.  The
+    same prove-it-learns discipline as ``__graft_entry__``'s dryrun
+    gate: a subtly wrong quantized reduction keeps params finite and
+    loss moving while computing the wrong numbers; only an accuracy
+    comparison catches it.  Returns ``(ok, delta)``; any probe failure
+    reads as not-ok (the caller degrades to f32 loudly, never crashes
+    the run for an optional optimization)."""
+    try:
+        # Chaos seam (tests/test_faults.py): an injected failure here is
+        # exactly a broken probe — the run must degrade to f32, loudly.
+        faults.site("grad_probe")
+
+        import dataclasses as _dc
+
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from ..config import (LoaderConfig, OptimizerConfig,
+                              SchedulerConfig)
+        from ..data.synthetic import get_data_synthetic
+
+        class _Probe(nn.Module):
+            """Minimal SSLClassifier-interface model for the gate."""
+
+            num_classes: int = 4
+            feat_dim: int = 32
+            freeze_feature: bool = False
+
+            @nn.compact
+            def __call__(self, x, train: bool = True,
+                         return_features: bool = False):
+                emb = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+                emb = nn.tanh(nn.Dense(self.feat_dim, name="proj")(emb))
+                logits = nn.Dense(self.num_classes, name="linear")(emb)
+                return (logits, emb) if return_features else logits
+
+        data = get_data_synthetic(n_train=96, n_test=128, num_classes=4,
+                                  image_size=16, seed=7)
+        base_cfg = TrainConfig(
+            eval_split=0.1, loader_tr=LoaderConfig(batch_size=16),
+            loader_te=LoaderConfig(batch_size=16),
+            optimizer=OptimizerConfig(name="sgd", lr=0.3),
+            scheduler=SchedulerConfig(name="cosine", t_max=8),
+            resident_scoring_bytes=0)
+
+        def fit_acc(mode: str) -> float:
+            trainer = Trainer(_Probe(),
+                              _dc.replace(base_cfg, grad_allreduce=mode),
+                              mesh, num_classes=4)
+            # The probe fits on the DETERMINISTIC (al) view: the int8
+            # step decorrelates per-shard augmentation keys, so an
+            # augmented view would compare two different data streams
+            # and the delta would measure augmentation luck, not
+            # quantization.  On the template+noise synthetic both paths
+            # saturate (~100%); a broken quantized reduction does not.
+            state = trainer.init_state(
+                jax.random.PRNGKey(1),
+                data[2].gather(np.zeros(1, dtype=np.int64)))
+            result = trainer.fit(
+                state, data[2], np.arange(len(data[2])), data[2],
+                np.array([], dtype=np.int64), n_epoch=8, es_patience=0,
+                rng=np.random.default_rng(1))
+            metrics = trainer.evaluate(result.state, data[1],
+                                       np.arange(len(data[1])))
+            return float(metrics["accuracy"])
+
+        delta = round(abs(fit_acc("f32") - fit_acc("int8")), 4)
+        return delta <= INT8_PROBE_MAX_ACC_DELTA, delta
+    except (Exception, faults.ThreadDeath) as e:  # noqa: BLE001
+        # Degrade, never crash: ThreadDeath included deliberately — the
+        # probe runs on the MAIN thread, where an injected
+        # grad_probe:die would otherwise kill the whole run instead of
+        # the f32 fallback this site's contract promises.
+        get_logger().warning(f"grad_allreduce probe failed to run: {e!r}")
+        return False, None
+
+
 def build_experiment(
     cfg: ExperimentConfig,
     sink: Optional[MetricsSink] = None,
@@ -208,9 +296,45 @@ def build_experiment(
     if cfg.feed_workers is not None:
         train_cfg = dataclasses.replace(train_cfg,
                                         feed_workers=cfg.feed_workers)
+    if cfg.fused_optimizer is not None:
+        # --fused_optimizer beats the arg pool: bit-identical to optax
+        # at f32 state, so this is a throughput/HBM deployment choice.
+        train_cfg = dataclasses.replace(train_cfg,
+                                        fused_optimizer=cfg.fused_optimizer)
+    if cfg.optim_state_dtype is not None:
+        train_cfg = dataclasses.replace(
+            train_cfg, optim_state_dtype=cfg.optim_state_dtype)
+    if cfg.grad_allreduce is not None:
+        train_cfg = dataclasses.replace(train_cfg,
+                                        grad_allreduce=cfg.grad_allreduce)
     if mesh is None:
         mesh = mesh_lib.make_mesh(cfg.num_devices)
+    # The quantized gradient sync is GATED, not just flagged
+    # (DESIGN.md §4): int8 only engages when the mesh is multi-device
+    # (resolve_grad_allreduce) AND the multichip learning probe passes —
+    # a tiny probe model trained through the int8 step must match its
+    # bit-exact-f32 twin's test accuracy within the pinned bound.  A
+    # probe failure (or injected grad_probe fault) degrades the run to
+    # f32 LOUDLY: logged here, journaled + metric'd by run_experiment
+    # via trainer.grad_allreduce_degraded.
+    grad_allreduce_degraded = False
+    requested_ar = getattr(train_cfg, "grad_allreduce", "f32") or "f32"
+    if mesh_lib.resolve_grad_allreduce(requested_ar, mesh) == "int8":
+        ok, delta = run_grad_allreduce_probe(mesh)
+        if not ok:
+            get_logger().warning(
+                "grad_allreduce=int8 FAILED the multichip learning probe "
+                f"(accuracy delta {delta if delta is not None else 'n/a'} "
+                f"vs bound {INT8_PROBE_MAX_ACC_DELTA}); degrading this "
+                "run to the bit-exact f32 gradient sync")
+            train_cfg = dataclasses.replace(train_cfg, grad_allreduce="f32")
+            grad_allreduce_degraded = True
+        else:
+            get_logger().info(
+                "grad_allreduce=int8: learning probe passed "
+                f"(accuracy delta {delta} <= {INT8_PROBE_MAX_ACC_DELTA})")
     trainer = Trainer(model, train_cfg, mesh, num_classes)
+    trainer.grad_allreduce_degraded = grad_allreduce_degraded
 
     targets = train_set.targets[: len(train_set)]
     init_pool_size = cfg.resolved_init_pool_size()
@@ -471,10 +595,35 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     journal = faults.RoundJournal(
         os.path.join(cfg.log_dir, faults.JOURNAL_FILE),
         enabled=mesh_lib.is_coordinator())
+    # A resumed run must not silently FLIP the gradient-sync precision
+    # mid-experiment: if the original launch's int8 probe failed (the
+    # journal records grad_allreduce=f32_degraded), every later segment
+    # of the same run stays on f32 — re-running the probe on resume and
+    # having it pass would splice bounded-delta int8 rounds onto
+    # bit-exact f32 ones under a journal that still says degraded.
+    # (The other direction — int8 run resumed, probe now fails — keeps
+    # the normal probe path: degrading TOWARD the bit-exact sync is
+    # always safe, and gets journaled again.)
+    prior_journal = faults.read_journal(
+        os.path.join(cfg.log_dir, faults.JOURNAL_FILE))
+    sticky_degrade = bool(
+        (resuming or preempted_round0) and prior_journal
+        and prior_journal.get("grad_allreduce") == "f32_degraded")
+    if sticky_degrade:
+        logger.info(
+            "resume: the original run degraded grad_allreduce to f32 "
+            "(journaled); keeping f32 for the resumed segment instead "
+            "of re-probing")
+        cfg.grad_allreduce = "f32"
     # Identity first: a preemption at ANY later point leaves a journal
     # the round-0 resume path above can verify belongs to THIS
     # experiment (the journal is keyed by log_dir, not exp_hash).
     journal.write(exp_name=cfg.exp_name, exp_hash=cfg.exp_hash)
+    if sticky_degrade:
+        # Re-assert the provenance the identity write just preserved
+        # alongside (merge-write keeps other fields; this keeps the
+        # degrade record explicit for `status --strict`/post-mortems).
+        journal.write(grad_allreduce="f32_degraded")
     # The ladder is built after the strategy exists; the watchdog's
     # callback closes over this box so a stall can reach it.
     ladder_box: dict = {}
@@ -524,6 +673,16 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         strategy = build_experiment(cfg, sink=sink, data=data, mesh=mesh,
                                     train_cfg=train_cfg, model=model,
                                     skip_init_pool=resuming)
+        if getattr(strategy.trainer, "grad_allreduce_degraded", False):
+            # The int8 learning probe failed (build_experiment already
+            # fell back to f32 and logged): surface it LOUDLY through
+            # the same channels a ladder escalation uses — the journal
+            # (status --strict renders degrade lists) and the
+            # degrade_events metric — so a run that silently trains
+            # bit-exact when int8 was asked for is impossible to miss.
+            journal.write(grad_allreduce="f32_degraded")
+            sink.log_metric("degrade_events", 1, step=-1)
+            sink.log_metric("grad_allreduce_degraded", 1, step=-1)
         if resuming:
             start_round = resume_lib.load_experiment(strategy, cfg)
             # The first fit of a resumed run may consume a mid-round fit

@@ -68,8 +68,12 @@ from typing import Any, Dict, Optional, Tuple
 #                 thread behind scoring/serving
 #   dispatch      parallel/mesh.DispatchGate.__enter__ — every
 #                 collective-bearing jitted dispatch
+#   grad_probe    experiment/driver.run_grad_allreduce_probe — the
+#                 multichip learning probe gating --grad_allreduce
+#                 int8 (an injected failure = a broken probe; the run
+#                 must degrade to the f32 sync loudly, never crash)
 SITES = ("h2d_upload", "ckpt_write", "spec_scorer", "feed_worker",
-         "shard_upload", "dispatch")
+         "shard_upload", "dispatch", "grad_probe")
 
 ACTIONS = ("raise", "oom", "die", "delay", "torn")
 

@@ -54,6 +54,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..ops import backward as backward_ops
+
 ModuleDef = Any
 
 # Space-to-depth block size for the 224px stem: 2x2 pixel blocks -> 12
@@ -120,7 +122,12 @@ class FusedBatchNorm(nn.Module):
     momentum: float = 0.9
     epsilon: float = 1e-5
     dtype: Any = jnp.float32
-    axis_name: Optional[str] = None  # accepted for API parity; unused
+    # Cross-device statistics axis: None (the jit path — the partitioner
+    # lowers the batch reductions to collectives itself, SyncBatchNorm
+    # for free) or a mesh axis name when the module runs inside a
+    # shard_map body (the int8 gradient-sync step), where local means
+    # must be pmean'd explicitly to keep global-batch semantics.
+    axis_name: Optional[str] = None
     scale_init: Callable = nn.initializers.ones
     bias_init: Callable = nn.initializers.zeros
 
@@ -143,19 +150,37 @@ class FusedBatchNorm(nn.Module):
 
         if use_ra:
             mean, var = ra_mean.value, ra_var.value
+        elif self.axis_name is None:
+            # Training statistics + normalize via the custom-VJP kernel
+            # (ops/backward.fused_bn_train): the primal is bit-identical
+            # to the inline bf16-reads/f32-accumulation math that lived
+            # here (the ``dtype`` reduce argument and in-reduce f32
+            # convert — no float32 copy materialized; the SQUARE happens
+            # in f32 because E[x²]−E[x]² amplifies bf16 squaring error
+            # into a clamped-to-zero variance whenever mean² ≫ var), and
+            # the BACKWARD keeps the same discipline instead of XLA's
+            # materialize-everything-as-f32 derivation (DESIGN.md §4,
+            # parity pinned in tests/test_backward.py).
+            y, mean, var = backward_ops.fused_bn_train(
+                x, scale, bias, dtype=self.dtype, epsilon=self.epsilon)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+            return y
         else:
+            # shard_map body (axis_name set): per-shard partial sums
+            # pmean'd into GLOBAL batch statistics — same global-batch
+            # BN the jit partitioner derives, up to reduction order.
+            # Plain autodiff backward here: this branch only runs on the
+            # quantized-gradient path, which is bounded-delta by
+            # contract anyway (parallel/mesh.int8_allreduce).
             x_stats = x.astype(self.dtype)
-            # bf16 element reads, float32 accumulators: the ``dtype``
-            # argument (and the in-reduce f32 convert below) set the XLA
-            # reduce's element/accumulation type without materializing a
-            # float32 copy — the convert fuses into the reduction's read.
-            # The SQUARE must happen in f32: squaring bf16 values first
-            # would feed E[x²]−E[x]² a ~2⁻⁹-relative-error term that the
-            # cancellation amplifies into a garbage (clamped-to-zero)
-            # variance whenever mean² ≫ var.
-            mean = jnp.mean(x_stats, axes, dtype=jnp.float32)
-            mean2 = jnp.mean(
-                jax.lax.square(x_stats.astype(jnp.float32)), axes)
+            mean = jax.lax.pmean(
+                jnp.mean(x_stats, axes, dtype=jnp.float32), self.axis_name)
+            mean2 = jax.lax.pmean(
+                jnp.mean(jax.lax.square(x_stats.astype(jnp.float32)),
+                         axes), self.axis_name)
             var = jnp.maximum(mean2 - jax.lax.square(mean), 0.0)
             if not self.is_initializing():
                 m = self.momentum
@@ -180,6 +205,28 @@ FusedBatchNorm.__qualname__ = "BatchNorm"
 # scale=1/bias=0 is the flax default.
 conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 dense_kernel_init = nn.initializers.normal(stddev=1e-3)
+
+
+class S2DStemConv(nn.Module):
+    """The s2d stem's 4x4/stride-1 conv with the hand-written backward
+    (ops/backward.stem_conv): forward bit-identical to the ``nn.Conv``
+    it replaces (same param name/shape/init — checkpoint trees are
+    unchanged), backward with bf16 reads and a float32-ACCUMULATED
+    weight gradient instead of XLA's bf16-accumulate-then-cast
+    derivation (DESIGN.md §4; parity pinned in tests/test_backward.py).
+    """
+
+    features: int
+    dtype: Any = jnp.float32
+    kernel_init: Callable = conv_kernel_init
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", self.kernel_init,
+                            (4, 4, x.shape[-1], self.features),
+                            jnp.float32)
+        return backward_ops.stem_conv(x, kernel, dtype=self.dtype,
+                                      padding=((2, 1), (2, 1)))
 
 
 class BasicBlock(nn.Module):
@@ -252,6 +299,10 @@ class ResNetEncoder(nn.Module):
     cifar_stem: bool = False
     stem: str = "default"  # "default" | "s2d" (224px path only)
     bn_stats_dtype: Any = None  # None/f32 -> flax BatchNorm; bf16 -> fused
+    # BN cross-device statistics axis for shard_map bodies (the int8
+    # gradient-sync train step) — None under plain jit, where the
+    # partitioner derives the collective itself.
+    axis_name: Optional[str] = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -263,7 +314,7 @@ class ResNetEncoder(nn.Module):
         norm = functools.partial(
             FusedBatchNorm if fused_stats else nn.BatchNorm,
             use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype, axis_name=None)
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.axis_name)
 
         x = x.astype(self.dtype)
         if self.cifar_stem:
@@ -280,9 +331,11 @@ class ResNetEncoder(nn.Module):
                 x = space_to_depth(x)
             # Exact refactoring of the 7x7/s2 stem: 4x4/s1 over 2x2-block
             # channels, explicit (2, 1) padding = the 7x7's pad-3 window
-            # in s2d coordinates (see s2d_stem_kernel).
-            x = conv(self.num_filters, (4, 4), (1, 1),
-                     padding=[(2, 1), (2, 1)], name="conv_stem")(x)
+            # in s2d coordinates (see s2d_stem_kernel).  S2DStemConv is
+            # forward-identical to the nn.Conv it replaced (same param
+            # tree) with the hand-written f32-accumulating backward.
+            x = S2DStemConv(self.num_filters, dtype=self.dtype,
+                            name="conv_stem")(x)
             x = norm(name="bn_stem")(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2),
@@ -325,6 +378,10 @@ class SSLClassifier(nn.Module):
     cifar_stem: bool = False
     stem: str = "default"
     bn_stats_dtype: Any = None
+    # BN cross-device axis for shard_map bodies; the trainer clones the
+    # model with this set when building the int8 gradient-sync step
+    # (``model.clone(axis_name=...)`` — parameters are unaffected).
+    axis_name: Optional[str] = None
     freeze_feature: bool = False
     dtype: Any = jnp.float32
 
@@ -332,7 +389,8 @@ class SSLClassifier(nn.Module):
         self.encoder = ResNetEncoder(
             stage_sizes=self.stage_sizes, block_cls=self.block_cls,
             cifar_stem=self.cifar_stem, stem=self.stem,
-            bn_stats_dtype=self.bn_stats_dtype, dtype=self.dtype,
+            bn_stats_dtype=self.bn_stats_dtype,
+            axis_name=self.axis_name, dtype=self.dtype,
             name="encoder")
         self.linear = nn.Dense(
             self.num_classes, kernel_init=dense_kernel_init,

@@ -1,6 +1,13 @@
-"""Custom TPU kernels (Pallas).
+"""Custom ops: places where measured XLA performance leaves headroom.
 
-Currently EMPTY, on purpose.  Only ops where measured XLA performance
+``backward.py`` — the hand-written backward passes (custom VJPs) for
+the two hand-built forward kernels: the s2d stem conv's f32-accumulated
+weight gradient and FusedBatchNorm's bf16-reads/f32-accumulation
+backward, replacing XLA's materialize-as-f32 derivation on the train
+step's gradient path (DESIGN.md §4; parity pinned by
+tests/test_backward.py, registry closed by trace_lint check 9).
+
+No Pallas kernels, on purpose.  Only ops where measured XLA performance
 leaves headroom get a kernel, and the one kernel that ever lived here —
 ``kcenter_pallas``, the k-center selection's fused batched
 distance-update + block-local argmax — failed that bar on real

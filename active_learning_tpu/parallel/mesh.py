@@ -286,6 +286,92 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+# -- quantized gradient sync (DESIGN.md §4, "The gradient path") ----------
+
+GRAD_ALLREDUCE_MODES = ("f32", "int8")
+
+# Elements per quantization block: one f32 scale amortized over 256
+# int8 payload bytes (~1.6% scale overhead), small enough that a block
+# shares one dynamic range (EQuARX's block-scaling argument: per-tensor
+# scales clip outlier-heavy gradients; per-block ones track them).
+INT8_BLOCK = 256
+
+
+def resolve_grad_allreduce(mode: str, mesh: Mesh) -> str:
+    """The ONE rule for which gradient-sync path a Trainer builds:
+    ``int8`` only on multi-device meshes (a single device has no wire
+    to save — the quantization would cost accuracy for nothing);
+    anything else is the partitioner's bit-exact f32 psum."""
+    if mode not in GRAD_ALLREDUCE_MODES:
+        raise ValueError(f"grad_allreduce={mode!r} is not one of "
+                         f"{'/'.join(GRAD_ALLREDUCE_MODES)}")
+    if mode == "int8" and mesh.devices.size <= 1:
+        return "f32"
+    return mode
+
+
+def int8_allreduce(tree: Any, axis: str = DATA_AXIS,
+                   block: int = INT8_BLOCK) -> Any:
+    """EQuARX-style block-scaled int8 gradient all-reduce, inside a
+    ``shard_map`` body over ``axis``: each device quantizes its local
+    gradients to int8 against a SHARED per-block scale (pmax of the
+    local absmax — every device must use one scale or the sums don't
+    commute), the collective moves the int8 payload, and each device
+    de-quantizes after a float32-accumulated local sum.
+
+    Wire model, honestly: this is the all_gather-then-local-sum form —
+    the only quantized reduction expressible in today's XLA ops (EQuARX
+    itself requantizes inside a modified ring all-reduce, which is not
+    user-expressible).  Per device it moves ``(ndev-1) * n`` int8 bytes
+    vs a ring f32 psum's ``~2 * 4 * n``, so the wire win is
+    ``8/(ndev-1)``: ~4x at 2-4 devices, still >1 through 8 (the
+    single-process single-host meshes this path targets today), and
+    INVERTED past ~9 devices — pod-scale needs a quantized
+    reduce-scatter and is deliberately out of scope (the auto rules
+    never pick int8 there: it is flag-only and the flag is default-off).
+
+    Deterministic and bounded: with a shared scale, round-to-nearest
+    per element, and an exact f32 sum of <=127-magnitude integers, the
+    result is identical on every device and the per-element error is
+    bounded by ``ndev * scale / 2`` with ``scale = blockmax / 127`` —
+    the delta the learning probe and tests/test_backward.py pin.  A
+    non-finite block (loss spike) poisons to NaN instead of quantizing
+    to garbage, so blow-ups stay as visible as on the f32 path.
+    Non-float leaves psum exactly.
+    """
+    def one(x):
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return jax.lax.psum(x, axis)
+        shape, dtype = x.shape, x.dtype
+        flat = x.reshape(-1).astype(jnp.float32)
+        n = flat.shape[0]
+        pad = (-n) % block
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+        blocks = flat.reshape(-1, block)
+        absmax = jax.lax.pmax(jnp.max(jnp.abs(blocks), axis=1), axis)
+        scale = jnp.maximum(absmax, jnp.float32(1e-30)) / 127.0
+        q = jnp.clip(jnp.round(blocks / scale[:, None]),
+                     -127, 127).astype(jnp.int8)
+        # int8 on the wire; the sum accumulates f32 AFTER the gather
+        # (summing in int8 would wrap past ndev=2).
+        gathered = jax.lax.all_gather(q, axis)
+        total = jnp.sum(gathered.astype(jnp.float32), axis=0)
+        # Non-finite gradients must SURFACE, exactly as the f32 psum
+        # would surface them: an inf/NaN block's scale is non-finite
+        # and round(x/inf)=0 would silently launder the blow-up into a
+        # zero gradient — poison the whole block to NaN instead so the
+        # grad-norm telemetry and any NaN guard still see it.
+        out = jnp.where(jnp.isfinite(absmax)[:, None],
+                        total * scale[:, None], jnp.float32(jnp.nan))
+        out = out.reshape(-1)
+        if pad:
+            out = out[:n]
+        return out.reshape(shape).astype(dtype)
+
+    return jax.tree.map(one, tree)
+
+
 def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh) -> Dict[str, Any]:
     """Host batch -> device arrays with the batch axis sharded over the
     mesh.  This is the host->device boundary (the reference's pinned-memory

@@ -12,16 +12,34 @@ Semantics preserved:
     (arg_pools/default.py:41-42, ssp_finetuning.py:31-33).  The trainer
     computes ``lr_at_epoch(epoch)`` on host and feeds the scalar into the
     jitted step — no recompilation, exact per-epoch semantics.
+
+The FUSED update path (``FusedSGD``, DESIGN.md §4 "The gradient path"):
+the production optimizer is always SGD+momentum+weight-decay, and the
+optax chain spells it as three tree traversals plus a fourth for
+``apply_updates`` — four full passes over ~100 MB of ResNet-50 state
+per step.  ``fused_sgd_update`` computes the WHOLE update per leaf in
+one expression (decay -> momentum -> -lr -> apply), so XLA fuses it
+into a single pass over each parameter with its momentum buffer, and
+the train step donates the momentum alongside the params (the optax
+path already donated the state pytree; the fused path also reuses those
+buffers at ROUND boundaries — ``Trainer.reinit_optimizer`` zeroes the
+donated tree in place instead of re-allocating + re-uploading a fresh
+one).  ``state_dtype=bf16`` stores the momentum in bfloat16 (HALF the
+optimizer HBM; read bf16 -> accumulate f32 -> round once on store —
+the same discipline as the BN statistics), ``f32`` is BIT-identical to
+the optax chain (pinned in tests/test_backward.py).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Callable
+from typing import Any, Callable, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import optax
 
-from ..config import OptimizerConfig, SchedulerConfig
+from ..config import OptimizerConfig, SchedulerConfig, TrainConfig
 from ..registry import OPTIMIZERS, SCHEDULERS
 
 
@@ -47,6 +65,121 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     """Learning-rate-agnostic transform; the lr is applied in the train step
     as ``updates * -lr`` so the host-side schedule stays exact."""
     return OPTIMIZERS.get(cfg.name)(cfg)
+
+
+# ---------------------------------------------------------------------------
+# The fused update path (see module docstring).
+# ---------------------------------------------------------------------------
+
+# Statically checked by scripts/trace_lint.py check 9: the fused update
+# functions run INSIDE the jitted train step and must never materialize
+# state on the host (no np.* references, no .asarray/device_get).
+FUSED_UPDATE_FNS = ("fused_sgd_init", "fused_sgd_update")
+
+OPTIM_STATE_DTYPES = ("f32", "bf16")
+
+
+def resolve_optim_state_dtype(name: str) -> Any:
+    if name not in OPTIM_STATE_DTYPES:
+        raise ValueError(f"optim_state_dtype={name!r} is not one of "
+                         f"{'/'.join(OPTIM_STATE_DTYPES)}")
+    return jnp.bfloat16 if name == "bf16" else jnp.float32
+
+
+def fused_sgd_init(params: Any, state_dtype: Any = jnp.float32) -> Any:
+    """Momentum buffers for ``fused_sgd_update``: zeros shaped like the
+    params in ``state_dtype`` (bf16 halves optimizer HBM)."""
+    return {"trace": jax.tree.map(
+        lambda p: jnp.zeros(p.shape, state_dtype), params)}
+
+
+def fused_sgd_update(grads: Any, opt_state: Any, params: Any, lr,
+                     momentum: float, weight_decay: float,
+                     state_dtype: Any) -> Tuple[Any, Any]:
+    """One fused SGD+momentum+weight-decay step: returns
+    ``(new_params, new_opt_state)``.
+
+    Per leaf, ONE expression — XLA fuses the whole update into a single
+    pass over (param, momentum) instead of the optax chain's four tree
+    traversals.  At f32 state the scalar op sequence is EXACTLY the
+    chain's (``g + wd*p``, ``d + momentum*t``, ``p + (-lr)*t'`` with
+    apply_updates' dtype cast), so the fused path is bit-identical to
+    optax (pinned in tests/test_backward.py); at bf16 state the buffer
+    is read bf16, accumulated f32, and rounded ONCE on store.
+    """
+    acc = jnp.float32
+
+    def leaf(p, g, t):
+        d = g + weight_decay * p if weight_decay else g
+        if momentum:
+            t_new = d + momentum * t.astype(acc)
+            t_store = t_new.astype(state_dtype)
+        else:
+            t_new, t_store = d, t
+        p_new = (p + (-lr) * t_new).astype(p.dtype)
+        return p_new, t_store
+
+    out = jax.tree.map(leaf, params, grads, opt_state["trace"])
+    new_params = jax.tree.map(lambda o: o[0], out,
+                              is_leaf=lambda o: isinstance(o, tuple))
+    new_trace = jax.tree.map(lambda o: o[1], out,
+                             is_leaf=lambda o: isinstance(o, tuple))
+    return new_params, {"trace": new_trace}
+
+
+class FusedSGD:
+    """The fused update's hyperparameters + state factory, resolved once
+    per Trainer (``make_fused_optimizer``)."""
+
+    def __init__(self, momentum: float, weight_decay: float,
+                 state_dtype: Any):
+        self.momentum = float(momentum)
+        self.weight_decay = float(weight_decay)
+        self.state_dtype = state_dtype
+
+    def init(self, params: Any) -> Any:
+        if not self.momentum:
+            # No momentum -> no buffers; reinit_optimizer's emptiness
+            # check relies on the tree having zero leaves.
+            return {"trace": {}}
+        return fused_sgd_init(params, self.state_dtype)
+
+    def update(self, grads: Any, opt_state: Any, params: Any, lr
+               ) -> Tuple[Any, Any]:
+        if not self.momentum:
+            # Stateless fused decay+apply (no momentum buffer).
+            def leaf(p, g):
+                d = g + self.weight_decay * p if self.weight_decay else g
+                return (p + (-lr) * d).astype(p.dtype)
+            return jax.tree.map(leaf, params, grads), opt_state
+        return fused_sgd_update(grads, opt_state, params, lr,
+                                self.momentum, self.weight_decay,
+                                self.state_dtype)
+
+
+def make_fused_optimizer(train_cfg: TrainConfig) -> Optional[FusedSGD]:
+    """The Trainer's ONE rule for whether the fused update path engages:
+    ``fused_optimizer`` "on"/"auto" x an SGD-family optimizer.  "on"
+    with a non-SGD optimizer fails fast (there is no fused Adam);
+    "auto" quietly keeps the optax path for it.  Returns None when the
+    optax path should run."""
+    mode = getattr(train_cfg, "fused_optimizer", "auto") or "auto"
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"fused_optimizer={mode!r} is not one of 'auto'/'on'/'off'")
+    is_sgd = train_cfg.optimizer.name.lower() == "sgd"
+    if mode == "off":
+        return None
+    if not is_sgd:
+        if mode == "on":
+            raise ValueError(
+                f"fused_optimizer=on requires an SGD-family optimizer; "
+                f"got {train_cfg.optimizer.name!r}")
+        return None
+    state_dtype = resolve_optim_state_dtype(
+        getattr(train_cfg, "optim_state_dtype", "f32") or "f32")
+    return FusedSGD(train_cfg.optimizer.momentum,
+                    train_cfg.optimizer.weight_decay, state_dtype)
 
 
 def _step_lr(cfg: SchedulerConfig, base_lr: float) -> Callable[[int], float]:

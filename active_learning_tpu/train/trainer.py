@@ -48,7 +48,7 @@ from ..parallel import mesh as mesh_lib
 from ..utils.logging import get_logger
 from . import checkpoint as ckpt_lib
 from .evaluation import accumulate_metrics, make_eval_step
-from .optim import make_lr_schedule, make_optimizer
+from .optim import make_fused_optimizer, make_lr_schedule, make_optimizer
 
 
 # Checkpoint IO under the ONE retry policy (DESIGN.md §10): a transient
@@ -107,6 +107,18 @@ class Trainer:
         self.current_ckpt_every = max(1, int(current_ckpt_every))
         self.logger = get_logger()
         self.tx = make_optimizer(train_cfg.optimizer)
+        # The fused update path (train/optim.FusedSGD, DESIGN.md §4):
+        # one tree-fused SGD+momentum+wd+apply expression inside the
+        # donated step instead of the optax chain's four traversals —
+        # bit-identical to optax at f32 state, bf16 momentum optional.
+        # None = the optax chain (non-SGD optimizers, fused "off").
+        self.fused_tx = make_fused_optimizer(train_cfg)
+        # Gradient-sync precision (parallel/mesh.resolve_grad_allreduce):
+        # "f32" keeps the partitioner's bit-exact psum inside plain jit;
+        # "int8" builds the shard_map step with the EQuARX-style
+        # block-scaled quantized all-reduce (multi-device meshes only).
+        self.grad_allreduce = mesh_lib.resolve_grad_allreduce(
+            getattr(train_cfg, "grad_allreduce", "f32") or "f32", mesh)
         self.lr_at = make_lr_schedule(train_cfg.scheduler,
                                       train_cfg.optimizer.lr)
         # Reference quirk (strategy.py:366-367): BN runs in eval mode during
@@ -120,9 +132,13 @@ class Trainer:
         # s2d model accepts either layout, so resident/epoch-scan gathers
         # stay raw 3-channel and transform on device for free.
         self._host_s2d = getattr(model, "stem", "default") == "s2d"
-        self._train_step = self._build_train_step()
+        self._train_step = (self._build_train_step_int8()
+                            if self.grad_allreduce == "int8"
+                            else self._build_train_step())
         self._chained_train_step = self._build_chained_train_step()
         self._epoch_scan: Optional[Callable] = None  # built on first use
+        # Donated round-boundary optimizer reset (fused path) — lazy.
+        self._reinit_opt: Optional[Callable] = None
         # The resident feed's per-batch execution form (CPU meshes; see
         # _build_resident_batch_step) — also lazy.
         self._resident_batch_step: Optional[Callable] = None
@@ -249,21 +265,65 @@ class Trainer:
             bs = max(bs, floor * self.n_devices)
         return bs
 
+    def _opt_init(self, params) -> Any:
+        return (self.fused_tx.init(params) if self.fused_tx is not None
+                else self.tx.init(params))
+
     def init_state(self, rng: jax.Array, sample_input: np.ndarray
                    ) -> TrainState:
         variables = self.model.init(rng, jnp.asarray(sample_input),
                                     train=False)
         variables = mesh_lib.replicate(variables, self.mesh)
-        opt_state = self.tx.init(variables["params"])
-        opt_state = mesh_lib.replicate(opt_state, self.mesh)
+        opt_state = mesh_lib.replicate(self._opt_init(variables["params"]),
+                                       self.mesh)
         return TrainState(params=variables["params"],
                           batch_stats=variables.get("batch_stats", {}),
                           opt_state=opt_state, step=jnp.zeros((), jnp.int32))
 
+    @staticmethod
+    def _opt_state_live(opt_state) -> bool:
+        """True when every leaf is a live (non-donated) device array —
+        the fused reinit may only zero buffers in place if the previous
+        round actually left them alive (a crashed attempt's restore
+        keeps the donated opt_state of the failed fit)."""
+        try:
+            return all(not leaf.is_deleted()
+                       for leaf in jax.tree.leaves(opt_state)
+                       if hasattr(leaf, "is_deleted"))
+        except Exception:  # noqa: BLE001 - conservatively reallocate
+            return False
+
     def reinit_optimizer(self, state: TrainState) -> TrainState:
         """Fresh optimizer state at the start of each round (the reference
-        constructs a new optimizer per round, strategy.py:345)."""
-        opt_state = mesh_lib.replicate(self.tx.init(state.params), self.mesh)
+        constructs a new optimizer per round, strategy.py:345).
+
+        Fused path: the prior round's momentum buffers are DONATED into
+        a jitted zeroing — XLA reuses the allocations in place, so the
+        round boundary adds no optimizer allocation and no host->device
+        upload (the optax path re-built the tree on host and re-uploaded
+        it every round; pinned in tests/test_backward.py).  Falls back
+        to a fresh init when the buffers are not live (first round, or a
+        failed attempt's restore left donated arrays behind)."""
+        if self.fused_tx is not None and self._opt_state_live(
+                state.opt_state) and jax.tree.leaves(state.opt_state):
+            if self._reinit_opt is None:
+                # out_shardings pins the REPLICATED layout: without it
+                # the zeroed tree comes back single-device, and the
+                # next fit's first train step would recompile against
+                # the changed input sharding (the zero-recompile
+                # warm-round invariant).
+                @functools.partial(
+                    jax.jit, donate_argnums=(0,),
+                    out_shardings=mesh_lib.replicated_sharding(self.mesh))
+                def _zero(opt_state):
+                    return jax.tree.map(jnp.zeros_like, opt_state)
+                self._reinit_opt = _zero
+                tele_runtime.get_run().register_jit(
+                    f"reinit_opt@{id(self):x}", self._reinit_opt)
+            return state.replace(opt_state=self._reinit_opt(state.opt_state),
+                                 step=jnp.zeros((), jnp.int32))
+        opt_state = mesh_lib.replicate(self._opt_init(state.params),
+                                       self.mesh)
         return state.replace(opt_state=opt_state,
                              step=jnp.zeros((), jnp.int32))
 
@@ -274,10 +334,24 @@ class Trainer:
 
     # -- jitted steps ----------------------------------------------------
 
+    def _apply_optimizer(self, grads, state: TrainState, lr):
+        """ONE optimizer-application rule shared by every step builder:
+        the fused single-pass update (train/optim.FusedSGD — donated
+        momentum, optional bf16 state) when enabled, else the optax
+        chain exactly as before.  Bit-identical at f32 state (pinned in
+        tests/test_backward.py).  Traced inside the jitted steps."""
+        if self.fused_tx is not None:
+            return self.fused_tx.update(grads, state.opt_state,
+                                        state.params, lr)
+        updates, new_opt_state = self.tx.update(grads, state.opt_state,
+                                                state.params)
+        updates = jax.tree.map(lambda u: -lr * u, updates)
+        return optax.apply_updates(state.params, updates), new_opt_state
+
     def _build_train_step(self):
         model = self.model
-        tx = self.tx
         train_bn = self.train_bn
+        apply_optimizer = self._apply_optimizer
 
         def loss_fn(params, batch_stats, x, labels, weights):
             variables = {"params": params, "batch_stats": batch_stats}
@@ -306,13 +380,94 @@ class Trainer:
             # Params/opt updates are untouched, so path equality
             # (tests/test_trainer_parallel.py) is unaffected.
             gnorm = optax.global_norm(grads)
-            updates, new_opt_state = tx.update(grads, state.opt_state,
-                                               state.params)
-            updates = jax.tree.map(lambda u: -lr * u, updates)
-            params = optax.apply_updates(state.params, updates)
+            params, new_opt_state = apply_optimizer(grads, state, lr)
             return state.replace(params=params, batch_stats=new_stats,
                                  opt_state=new_opt_state,
                                  step=state.step + 1), loss, gnorm
+
+        return train_step
+
+    def _build_train_step_int8(self):
+        """The quantized-gradient-sync train step (DESIGN.md §4): the
+        same signature and contract as ``_build_train_step`` — every
+        wrapper (chained/resident/epoch-scan) composes unchanged — but
+        built over ``shard_map`` so the gradient reduction is OURS, not
+        the partitioner's: each device computes grads of its batch
+        shard's slice of the global loss, then syncs them through the
+        EQuARX-style block-scaled int8 all-reduce
+        (mesh_lib.int8_allreduce, ~4x fewer wire bytes than the f32
+        psum).  BatchNorm keeps GLOBAL-batch statistics via explicitly
+        pmean'd means (the model is cloned with ``axis_name`` when it
+        supports one; BN-free models run as-is).  This path is
+        BOUNDED-DELTA vs the f32 step, never bit-exact — it only builds
+        when ``--grad_allreduce int8`` survives the resolve rule and
+        the driver's learning probe."""
+        axis = mesh_lib.DATA_AXIS
+        mesh = self.mesh
+        train_bn = self.train_bn
+        apply_optimizer = self._apply_optimizer
+        try:
+            model = self.model.clone(axis_name=axis)
+            self._int8_axis_fallback = False
+        except TypeError:
+            # Models without an axis_name field carry no way to sync
+            # cross-device statistics.  Fine for BN-free models (the
+            # test classifiers); a train-mode-BN model here would
+            # silently compute per-shard statistics — fit() refuses
+            # that combination loudly (the batch_stats tree tells it
+            # whether mutable statistics actually exist).
+            model = self.model
+            self._int8_axis_fallback = True
+        from jax.experimental.shard_map import shard_map
+
+        def loss_fn(params, batch_stats, x, labels, weights):
+            variables = {"params": params, "batch_stats": batch_stats}
+            if train_bn:
+                logits, mutated = model.apply(
+                    variables, x, train=True, mutable=["batch_stats"])
+                new_stats = mutated["batch_stats"]
+            else:
+                logits = model.apply(variables, x, train=False)
+                new_stats = batch_stats
+            # The global weighted CE, written shard-locally: local
+            # numerator over the GLOBAL (psum'd) denominator — the
+            # per-shard losses SUM to the global loss, so summed local
+            # grads == global grads (the DDP contract).
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ce = -jnp.take_along_axis(
+                logp, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+            denom = jnp.maximum(
+                jax.lax.psum(jnp.sum(weights), axis), 1e-12)
+            return jnp.sum(ce * weights) / denom, new_stats
+
+        def body(state, batch, key, lr, class_weights, view):
+            # Decorrelate per-shard augmentation draws: each shard sees
+            # a fold_in'd key (the f32 path draws one batch-wide key;
+            # int8 is bounded-delta, not bit-exact, by contract).
+            aug_key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            x = apply_view(batch["image"], view, key=aug_key, train=True)
+            weights = class_weights[batch["label"]] * batch["mask"]
+            (loss_local, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, state.batch_stats, x,
+                                       batch["label"], weights)
+            grads = mesh_lib.int8_allreduce(grads, axis)
+            loss = jax.lax.psum(loss_local, axis)
+            gnorm = optax.global_norm(grads)
+            params, new_opt_state = apply_optimizer(grads, state, lr)
+            return state.replace(params=params, batch_stats=new_stats,
+                                 opt_state=new_opt_state,
+                                 step=state.step + 1), loss, gnorm
+
+        @functools.partial(jax.jit, static_argnames=("view",),
+                           donate_argnums=(0,))
+        def train_step(state, batch, key, lr, class_weights, view):
+            sharded = shard_map(
+                functools.partial(body, view=view), mesh=mesh,
+                in_specs=(mesh_lib.P(), mesh_lib.P(axis), mesh_lib.P(),
+                          mesh_lib.P(), mesh_lib.P()),
+                out_specs=(mesh_lib.P(), mesh_lib.P(), mesh_lib.P()),
+                check_rep=False)
+            return sharded(state, batch, key, lr, class_weights)
 
         return train_step
 
@@ -881,6 +1036,21 @@ class Trainer:
                                     max_bytes=self.cfg.cache_eval_bytes)
         labels = train_set.targets[labeled_idxs]
         class_weights = jnp.asarray(self.class_weights(labels))
+        if (self.grad_allreduce == "int8"
+                and getattr(self, "_int8_axis_fallback", False)
+                and self.train_bn
+                and jax.tree.leaves(state.batch_stats)):
+            # The int8 step could not thread the mesh axis into this
+            # model (no axis_name field) AND the model carries mutable
+            # batch statistics that would train PER-SHARD inside the
+            # shard_map body — divergent, silently-wrong BN.  Refuse
+            # loudly; grad_allreduce=f32 (or an axis_name-capable
+            # model) is the fix.
+            raise ValueError(
+                "grad_allreduce=int8 with a train-mode-BatchNorm model "
+                "that has no axis_name field: cross-device statistics "
+                "cannot be synced inside the quantized step — use "
+                "--grad_allreduce f32 or a model exposing axis_name")
         state = self.reinit_optimizer(state)
         bs = self.padded_batch_size(self.cfg.loader_tr.batch_size)
 
@@ -945,12 +1115,31 @@ class Trainer:
             saved = ckpt_lib.load_fit_state(weight_paths["fit_state"],
                                             round_idx)
             if saved is not None:
+                try:
+                    opt_state = serialization.from_state_dict(
+                        jax.tree.map(np.asarray, state.opt_state),
+                        saved["opt_state"])
+                except Exception:  # noqa: BLE001 - layout drift
+                    # The saved optimizer state has a different pytree
+                    # layout than this Trainer's (the fused path's
+                    # {"trace": ...} vs the optax chain's tuple state —
+                    # a --fused_optimizer change, or a pre-fused-era
+                    # checkpoint resumed under the new default).  The
+                    # fit state is all-or-nothing (its rng chain and
+                    # epoch counter assume the whole restore): discard
+                    # it and restart the round from scratch rather than
+                    # crash the resume.
+                    self.logger.warning(
+                        "mid-round fit state holds an incompatible "
+                        "optimizer-state layout (the optimizer path "
+                        "changed between runs); discarding it — round "
+                        f"{round_idx} restarts from its first epoch")
+                    ckpt_lib.delete_fit_state(weight_paths["fit_state"])
+                    saved = None
+            if saved is not None:
                 host = jax.tree.map(np.asarray, state.variables)
                 variables = serialization.from_state_dict(
                     host, saved["variables"])
-                opt_state = serialization.from_state_dict(
-                    jax.tree.map(np.asarray, state.opt_state),
-                    saved["opt_state"])
                 state = TrainState(
                     params=mesh_lib.replicate(variables["params"],
                                               self.mesh),

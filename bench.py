@@ -189,17 +189,19 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # Hard bound on the ONE stdout line: the consuming harness records a
 # ~2,000-byte tail of stdout — which carries nothing but this line — so
 # the bound needs enough margin for tail-window slop, not another whole
-# line.  1840 leaves 160 bytes of margin and fits the 13-phase
+# line.  1900 leaves 100 bytes of margin and fits the 13-phase
 # realistic-maximal rich form (every phase cached with every optional
 # rider: the feed-hierarchy fields, unit/backend on BOTH paper-scale
 # selection phases, the sharded-ceiling probe's pool_sharding tag,
 # pipeline/overlap on both end-to-end round phases — ISSUE 7, ~90
-# bytes — and now the failure-model counters retries/degraded on both
-# round phases — ISSUE 8, worst case '"retries":NN,"degraded":N,' x2 ≈
-# 50 bytes) without truncation; staged truncation in _compact_line
-# still guards the pathological cases.  Pinned by unit tests at both
+# bytes — the failure-model counters retries/degraded on both round
+# phases — ISSUE 8, worst case '"retries":NN,"degraded":N,' x2 ≈ 50
+# bytes — and now the gradient-path riders on both TRAIN phases —
+# ISSUE 10, worst case '"bwd_frac":0.NNN,"grad_ar":"int8",' x2 ≈ 68
+# bytes) without truncation; staged truncation in _compact_line still
+# guards the pathological cases.  Pinned by unit tests at both
 # extremes.
-MAX_LINE_BYTES = 1840
+MAX_LINE_BYTES = 1900
 
 
 def log(msg: str) -> None:
@@ -1654,6 +1656,97 @@ def _train_runner(trainer, batch, state, n_classes, view, seed: int):
     return step_once, (lambda: float(h["loss"])), h
 
 
+def _grad_path_fields(trainer, holder, batch, n_classes, view,
+                      step_sec: float, iters: int) -> dict:
+    """The backward-decomposition riders for a train phase (ISSUE 10):
+    time a forward-only step and the fused optimizer update alone with
+    the SAME timing discipline as the primary loop, and attribute the
+    remainder of the measured step to the backward pass —
+    ``bwd_frac`` — alongside ``opt_update_ms`` and the gradient-path
+    flags (``optim_state_dtype``/``grad_allreduce``/``fused_optimizer``)
+    so every train number is attributable to its gradient-path
+    configuration.  Short loops (max(4, iters//4)): these are
+    decomposition ratios, not headline rates."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from active_learning_tpu.data.augment import apply_view
+    from active_learning_tpu.train.trainer import weighted_cross_entropy
+
+    model = trainer.model
+    train_bn = trainer.train_bn
+    cw = jnp.ones(n_classes, jnp.float32)
+    sub_iters = max(4, iters // 4)
+    variables = holder["state"].variables
+
+    @jax.jit
+    def fwd_once(variables, batch, key, carry):
+        x = apply_view(batch["image"], view, key=key, train=True)
+        if train_bn:
+            logits, _ = model.apply(variables, x, train=True,
+                                    mutable=["batch_stats"])
+        else:
+            logits = model.apply(variables, x, train=False)
+        w = cw[batch["label"]] * batch["mask"]
+        return carry + weighted_cross_entropy(logits, batch["label"], w)
+
+    h = {"carry": jnp.float32(0.0), "k": jax.random.PRNGKey(7)}
+
+    def fwd_step():
+        h["k"], sub = jax.random.split(h["k"])
+        h["carry"] = fwd_once(variables, batch, sub, h["carry"])
+
+    fwd_dt = _time_loop(fwd_step, lambda: float(h["carry"]), sub_iters)
+    fields = {
+        "optim_state_dtype": getattr(trainer.cfg, "optim_state_dtype",
+                                     "f32"),
+        "grad_allreduce": trainer.grad_allreduce,
+        "fused_optimizer": trainer.fused_tx is not None,
+    }
+    # The optimizer-update loop times WHICHEVER path the measured step
+    # ran — fused single-pass or the optax chain — so bwd_frac never
+    # attributes optimizer time to the backward (a fused-on/off A/B
+    # must show the win under opt_update_ms, not as a phantom
+    # backward-pass change).
+    import optax
+
+    fused = trainer.fused_tx
+    tx = trainer.tx
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def opt_once(params, opt_state, grads, lr):
+        if fused is not None:
+            return fused.update(grads, opt_state, params, lr)
+        updates, new_state = tx.update(grads, opt_state, params)
+        updates = jax.tree.map(lambda u: -lr * u, updates)
+        return optax.apply_updates(params, updates), new_state
+
+    params = jax.tree.map(jnp.copy, variables["params"])
+    grads = jax.tree.map(lambda p: jnp.full(p.shape, 1e-4, p.dtype),
+                         params)
+    oh = {"p": params,
+          "o": fused.init(params) if fused is not None
+          else tx.init(params)}
+
+    def opt_step():
+        oh["p"], oh["o"] = opt_once(oh["p"], oh["o"], grads,
+                                    jnp.float32(0.1))
+
+    def opt_sync():
+        return float(jax.tree.leaves(oh["p"])[0].reshape(-1)[0])
+
+    opt_dt = _time_loop(opt_step, opt_sync, sub_iters)
+    opt_sec = opt_dt / sub_iters
+    fields["opt_update_ms"] = round(opt_sec * 1000.0, 3)
+    fwd_sec = fwd_dt / sub_iters
+    if step_sec > 0:
+        fields["bwd_frac"] = round(
+            max(0.0, (step_sec - fwd_sec - opt_sec) / step_sec), 3)
+    return fields
+
+
 def _score_runner(model, score_view, variables, batch):
     """(step_once, sync, sstep, sbatch) for the scoring pass.  A scalar is
     chained through every iteration INSIDE one jitted call so the final
@@ -1791,6 +1884,22 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
     if profile_dir:
         result["profiled"] = True  # trace overhead in dt: never cached
     yield dict(result)  # the measurement is safe with the parent now
+
+    if kind == "train":
+        # Backward decomposition riders (best-effort AFTER the primary
+        # number is safe): bwd_frac / opt_update_ms + the gradient-path
+        # flags, from short fwd-only and optimizer-only loops under the
+        # same timing discipline.
+        try:
+            result.update(_grad_path_fields(
+                trainer, holder, batch, n_classes, train_view,
+                dt / iters, iters))
+            log(f"[{phase}] bwd_frac={result.get('bwd_frac')} "
+                f"opt_update_ms={result.get('opt_update_ms')} "
+                f"grad_allreduce={result.get('grad_allreduce')}")
+            yield dict(result)
+        except Exception as e:
+            log(f"[{phase}] backward decomposition unavailable: {e!r}")
 
     if jax.devices()[0].platform == "tpu":
         # Batch-size lever for the MFU question (VERDICT r3 #4: train MFU
@@ -2253,7 +2362,16 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                             ("overlap_frac", "overlap"),
                             ("fault_retries_total", "retries"),
                             ("degrade_events", "degraded"))
-                           if name.startswith("al_round") else ())):
+                           if name.startswith("al_round") else ()),
+                         # The gradient-path riders (ISSUE 10) ride only
+                         # the TRAIN phases (their subject): the
+                         # backward's share of the step and the sync
+                         # precision the number was measured under — a
+                         # train MFU claim is ambiguous without them.
+                         # opt_update_ms stays in the evidence file.
+                         *((("bwd_frac", "bwd_frac"),
+                            ("grad_allreduce", "grad_ar"))
+                           if name.endswith("_train") else ())):
             if e.get(src) is not None and dst not in c:
                 c[dst] = e[src]
         if name == "imagenet_train_feed":

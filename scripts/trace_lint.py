@@ -63,6 +63,21 @@ DESIGN.md §10):
      retry``" rule: what a call site considers transient is always
      written at the call site.
 
+... and the gradient path's PROVEN-BACKWARD invariant (custom VJPs +
+the fused optimizer, DESIGN.md §4):
+
+  9. Every ``jax.custom_vjp`` in the package lives in
+     ``ops/backward.py`` (a hand-written backward anywhere else would
+     dodge the registry), its public name appears in that module's
+     ``TRAIN_PATH_VJPS`` tuple, and ``tests/test_backward.py``'s
+     ``PARITY_TESTED_VJPS`` tuple matches it exactly — a closed
+     registry like check 8: a custom backward without a registered
+     gradient-parity test can never land.  The fused optimizer-update
+     functions (``train/optim.py``'s ``FUSED_UPDATE_FNS``) run inside
+     the donated train step and are forbidden host materialization
+     (``np.*`` references, ``.asarray``/``device_get``/
+     ``block_until_ready`` calls).
+
 Stdlib only; exits 0 clean / 1 with findings on stderr.
 """
 
@@ -119,6 +134,14 @@ PIPELINE_COORDINATOR_FNS = ("_worker", "_worker_loop", "_score_slice",
 _PIPELINE_SYNC_CALLS = {"block_until_ready", "device_get"}
 
 FAULTS_REGISTRY = os.path.join(PKG, "faults", "registry.py")
+
+OPS_BACKWARD = os.path.join(PKG, "ops", "backward.py")
+OPTIM = os.path.join(PKG, "train", "optim.py")
+BACKWARD_TESTS = os.path.join(REPO, "tests", "test_backward.py")
+# Host-materialization markers forbidden inside the fused optimizer
+# update functions (they trace inside the donated train step).
+_FUSED_HOST_CALLS = {"asarray", "device_get", "block_until_ready",
+                     "gather"}
 
 
 def _py_files():
@@ -223,6 +246,150 @@ def check() -> list:
     # retry call site classifies.
     problems.extend(check_fault_sites())
 
+    # 9. Every custom VJP is registered and parity-tested; the fused
+    # optimizer update never touches the host.
+    problems.extend(check_backward_registry())
+
+    return problems
+
+
+def _str_tuple(tree: ast.AST, name: str, rel: str, problems: list):
+    """Parse a module-level ``NAME = ("a", "b", ...)`` tuple of string
+    literals; returns None (with a finding) when absent/non-literal."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                break
+            names = []
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value,
+                                                                str):
+                    names.append(elt.value)
+                else:
+                    problems.append(
+                        f"{rel}:{elt.lineno}: {name} holds a non-literal "
+                        "entry — the registry must be statically "
+                        "checkable")
+            return names
+    problems.append(f"{rel}: {name} tuple not found — the backward "
+                    "registry has nothing to check against")
+    return None
+
+
+def check_backward_registry(files=None, ops_path: str = OPS_BACKWARD,
+                            optim_path: str = OPTIM,
+                            tests_path: str = BACKWARD_TESTS) -> list:
+    """The gradient path's proven-backward invariant, statically
+    (check 9): custom VJPs only in ops/backward.py, every one named in
+    its ``TRAIN_PATH_VJPS`` and matched by ``PARITY_TESTED_VJPS`` in
+    tests/test_backward.py, and the fused optimizer-update functions
+    free of host materialization.  ``files`` given = a negative-case
+    unit test on a fragment (the custom_vjp location scan only)."""
+    problems = []
+
+    # a) custom_vjp usage is confined to ops/backward.py.
+    full_tree = files is None
+    paths = list(_py_files()) if full_tree else list(files)
+    for path in paths:
+        if os.path.abspath(path) == os.path.abspath(ops_path):
+            continue
+        rel = os.path.relpath(path, REPO)
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            problems.append(f"{rel}: unreadable for the backward-registry "
+                            f"check ({e})")
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr == "custom_vjp":
+                problems.append(
+                    f"{rel}:{node.lineno}: jax.custom_vjp outside "
+                    "ops/backward.py — hand-written backwards live in "
+                    "the closed registry (TRAIN_PATH_VJPS) so each one "
+                    "carries a gradient-parity test")
+    if not full_tree:
+        return problems
+
+    # b) the registry itself: TRAIN_PATH_VJPS names exist as defs and
+    # the module really uses custom_vjp.
+    rel_ops = os.path.relpath(ops_path, REPO)
+    try:
+        with open(ops_path) as fh:
+            ops_tree = ast.parse(fh.read())
+    except (OSError, SyntaxError) as e:
+        return problems + [f"{rel_ops}: unreadable for the "
+                           f"backward-registry check ({e})"]
+    registered = _str_tuple(ops_tree, "TRAIN_PATH_VJPS", rel_ops, problems)
+    if registered is None:
+        return problems
+    defs = {n.name for n in ast.walk(ops_tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name in registered:
+        if name not in defs:
+            problems.append(
+                f"{rel_ops}: TRAIN_PATH_VJPS names {name!r} but no such "
+                "function is defined — the registry drifted from the "
+                "module")
+    if not any(isinstance(n, ast.Attribute) and n.attr == "custom_vjp"
+               for n in ast.walk(ops_tree)):
+        problems.append(
+            f"{rel_ops}: no jax.custom_vjp usage found — TRAIN_PATH_VJPS "
+            "registers backwards that do not exist")
+
+    # c) every registered VJP has a registered parity test.
+    rel_tests = os.path.relpath(tests_path, REPO)
+    try:
+        with open(tests_path) as fh:
+            tests_tree = ast.parse(fh.read())
+    except (OSError, SyntaxError) as e:
+        return problems + [f"{rel_tests}: unreadable — every custom VJP "
+                           f"must carry a parity test ({e})"]
+    tested = _str_tuple(tests_tree, "PARITY_TESTED_VJPS", rel_tests,
+                        problems)
+    if tested is not None and set(tested) != set(registered):
+        problems.append(
+            f"{rel_tests}: PARITY_TESTED_VJPS {sorted(tested)} != "
+            f"TRAIN_PATH_VJPS {sorted(registered)} — a custom backward "
+            "without a registered gradient-parity test (or a stale test "
+            "registration) can never land")
+
+    # d) the fused update functions never touch the host.
+    rel_optim = os.path.relpath(optim_path, REPO)
+    try:
+        with open(optim_path) as fh:
+            optim_tree = ast.parse(fh.read())
+    except (OSError, SyntaxError) as e:
+        return problems + [f"{rel_optim}: unreadable for the fused-update "
+                           f"check ({e})"]
+    fused = _str_tuple(optim_tree, "FUSED_UPDATE_FNS", rel_optim, problems)
+    if fused is None:
+        return problems
+    fns = {n.name: n for n in ast.walk(optim_tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name in fused:
+        fn = fns.get(name)
+        if fn is None:
+            problems.append(
+                f"{rel_optim}: FUSED_UPDATE_FNS names {name!r} but no "
+                "such function is defined")
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == "np":
+                problems.append(
+                    f"{rel_optim}:{node.lineno}: {name} references np — "
+                    "the fused update traces inside the donated train "
+                    "step and must never materialize state on the host")
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _FUSED_HOST_CALLS:
+                problems.append(
+                    f"{rel_optim}:{node.lineno}: {name} calls "
+                    f".{node.func.attr}() — host materialization inside "
+                    "the fused optimizer update")
     return problems
 
 

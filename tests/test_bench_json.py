@@ -94,6 +94,12 @@ class TestDegradedModeLine:
                 # line under their canonical names.
                 "step_time_ms_p50": 48.2, "step_time_ms_p99": 61.7,
                 "step_time_source": "host-cadence",
+                # The gradient-path riders (ISSUE 10): the backward's
+                # share of the step and the sync precision ride the
+                # line on train phases; opt_update_ms stays in the
+                # evidence file.
+                "bwd_frac": 0.581, "opt_update_ms": 3.4,
+                "grad_allreduce": "f32", "optim_state_dtype": "f32",
                 "captured_utc": "2026-01-01T00:00:00Z",
             }
         }
@@ -110,6 +116,12 @@ class TestDegradedModeLine:
         # The degraded-mode line carries the step-time percentiles.
         assert phase["step_time_ms_p50"] == pytest.approx(48.2)
         assert phase["step_time_ms_p99"] == pytest.approx(61.7)
+        # ... and the gradient-path riders, under their line spellings.
+        assert phase["bwd_frac"] == pytest.approx(0.581)
+        assert phase["grad_ar"] == "f32"
+        # The finer figures stay in the evidence file, off the line.
+        assert "opt_update_ms" not in phase
+        assert "optim_state_dtype" not in phase
 
     def test_feed_fields_and_datapath_rename_ride_the_line(self, tmp_path):
         """The feed-hierarchy numbers (imagenet_train_feed, feed_source/

@@ -379,6 +379,64 @@ class TestPipelinedRoundCompileReuse:
                 f"{deltas[rd]} jit cache misses")
 
 
+class TestGradPathCompileReuse:
+    def test_warm_rounds_zero_new_compiles_under_all_new_flags(
+            self, tmp_path):
+        """ISSUE 10's compile-freeness acceptance: the fused donated
+        optimizer (bf16 momentum), the donated round-boundary reinit,
+        AND the int8 quantized gradient sync together — 3 driver rounds
+        on the multi-device CPU mesh, rounds 1-2 at jit delta 0 (the
+        same registry-counted metric the production driver exports).
+        The int8 learning probe runs inside round 0's cold window, so
+        its compiles land in the cold tax, never the warm rounds."""
+        import json
+        import os
+
+        from active_learning_tpu.config import (ExperimentConfig,
+                                                TelemetryConfig)
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from active_learning_tpu.experiment import arg_pools  # noqa: F401
+        from active_learning_tpu.experiment.driver import run_experiment
+        from active_learning_tpu.utils.metrics import JsonlSink
+
+        from helpers import TinyClassifier, tiny_train_config
+
+        tmp = str(tmp_path)
+        cfg = ExperimentConfig(
+            dataset="synthetic", arg_pool="synthetic",
+            strategy="MarginSampler", rounds=3, round_budget=8,
+            n_epoch=2, early_stop_patience=2, log_dir=tmp, ckpt_path=tmp,
+            exp_hash="gradwarm", round_pipeline="off",
+            fused_optimizer="on", optim_state_dtype="bf16",
+            grad_allreduce="int8",
+            telemetry=TelemetryConfig(enabled=True,
+                                      heartbeat_every_s=0.0))
+        data = get_data_synthetic(n_train=96, n_test=32, num_classes=4,
+                                  image_size=8, seed=5)
+        strategy = run_experiment(
+            cfg, sink=JsonlSink(tmp, experiment_key="gradwarm"),
+            data=data, train_cfg=tiny_train_config(),
+            model=TinyClassifier(num_classes=4))
+        assert strategy.trainer.fused_tx is not None
+        assert strategy.trainer.grad_allreduce == "int8"
+        assert not strategy.trainer.grad_allreduce_degraded
+        deltas = {}
+        with open(os.path.join(tmp, "metrics.jsonl")) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                if (ev.get("kind") == "metric"
+                        and "jit_cache_miss_delta" in ev.get("metrics",
+                                                             {})):
+                    deltas[ev.get("step")] = \
+                        ev["metrics"]["jit_cache_miss_delta"]
+        assert set(deltas) == {0, 1, 2}
+        assert deltas[0] > 0  # cold round pays the compiles ...
+        for rd in (1, 2):  # ... warm rounds pay none, under every flag.
+            assert deltas[rd] == 0, (
+                f"warm round {rd} compiled under the gradient-path "
+                f"flags: {deltas[rd]} jit cache misses")
+
+
 class TestCompilationCacheConfig:
     def test_driver_enables_persistent_cache(self, tmp_path, monkeypatch):
         from active_learning_tpu.experiment import driver

@@ -92,7 +92,8 @@ class TestSpecGrammar:
         # by trace_lint check 8; this pins the registry contents so a
         # rename shows up here too.
         assert faults.SITES == ("h2d_upload", "ckpt_write", "spec_scorer",
-                                "feed_worker", "shard_upload", "dispatch")
+                                "feed_worker", "shard_upload", "dispatch",
+                                "grad_probe")
 
 
 # ---------------------------------------------------------------------------
@@ -705,6 +706,93 @@ class TestChaosMatrix:
         cfg = args_to_config(args)
         assert cfg.fault_spec == "h2d_upload:raise@3"
         assert cfg.telemetry.watchdog_action == "degrade"
+
+
+class TestGradPathFaults:
+    """ISSUE 10's fault-site coverage: the fused optimizer update and
+    the int8 gradient sync are reachable from the PR 8 ladder."""
+
+    @pytest.mark.parametrize("action", ["raise", "die"])
+    def test_grad_probe_failure_degrades_int8_to_f32_loudly(
+            self, chaos_data, baseline, tmp_path, action):
+        """--grad_allreduce int8 with a broken learning probe (injected
+        grad_probe fault): the run must complete on the bit-exact f32
+        sync — bit-identical to the fault-free baseline, since f32 IS
+        the baseline's path — with the degrade journaled and metric'd,
+        never silent and never fatal.  ``die`` (ThreadDeath) included:
+        the probe runs on the MAIN thread, where an uncaught injected
+        death would kill the run instead of degrading it."""
+        cfg = dataclasses.replace(
+            _e2e_cfg(f"gradprobe_{action}", str(tmp_path)),
+            grad_allreduce="int8", round_pipeline="off",
+            fault_spec=f"grad_probe:{action}@1")
+        run_experiment(cfg, sink=None, data=chaos_data,
+                       train_cfg=tiny_train_config(),
+                       model=TinyClassifier(num_classes=4))
+        state = dict(np.load(glob.glob(os.path.join(
+            cfg.ckpt_path, "*", "experiment_state.npz"))[0]))
+        # Degraded = trained on f32 = the baseline's exact math.
+        for k in baseline:
+            assert np.array_equal(baseline[k], state[k]), (
+                f"experiment_state[{k!r}] diverged under the probe-"
+                "degraded f32 fallback")
+        jr = journal_lib.read_journal(
+            os.path.join(cfg.log_dir, faults.JOURNAL_FILE))
+        assert jr["status"] == "finished"
+        assert jr["grad_allreduce"] == "f32_degraded"
+        assert (_metric_max(cfg.log_dir, "degrade_events") or 0) >= 1
+        assert (_metric_max(cfg.log_dir,
+                            "grad_allreduce_degraded") or 0) >= 1
+        log = glob.glob(os.path.join(cfg.log_dir, "*.log"))[0]
+        assert "FAILED the multichip learning probe" in open(log).read()
+
+    def test_probe_degrade_is_sticky_across_resume(
+            self, chaos_data, tmp_path):
+        """A run whose probe failed (journaled f32_degraded) must STAY
+        on f32 when resumed — re-probing on resume and flipping to
+        int8 would splice bounded-delta rounds onto bit-exact ones
+        under a journal that still says degraded."""
+        cfg = dataclasses.replace(
+            _e2e_cfg("stickyar", str(tmp_path)),
+            grad_allreduce="int8", round_pipeline="off",
+            fault_spec="grad_probe:raise@1")
+        run_experiment(cfg, sink=NullSink(), data=chaos_data,
+                       train_cfg=tiny_train_config(),
+                       model=TinyClassifier(num_classes=4))
+        # Resume (fault-free, more rounds): the probe would PASS now —
+        # the sticky rule must keep f32 and skip it.
+        cfg2 = dataclasses.replace(
+            _e2e_cfg("stickyar", str(tmp_path), resume=True),
+            grad_allreduce="int8", round_pipeline="off", rounds=3)
+        strategy = run_experiment(cfg2, sink=NullSink(), data=chaos_data,
+                                  train_cfg=tiny_train_config(),
+                                  model=TinyClassifier(num_classes=4))
+        assert strategy.trainer.grad_allreduce == "f32"
+        jr = journal_lib.read_journal(
+            os.path.join(cfg2.log_dir, faults.JOURNAL_FILE))
+        assert jr["grad_allreduce"] == "f32_degraded"
+        log = glob.glob(os.path.join(cfg2.log_dir, "*.log"))[0]
+        assert "keeping f32 for the resumed segment" in open(log).read()
+
+    def test_fused_update_oom_routes_to_batch_half(
+            self, chaos_data, tmp_path):
+        """An OOM surfacing from the fused-optimizer train-step
+        dispatch (the dispatch site wraps every jitted train dispatch;
+        the fused update executes inside it) costs a round ATTEMPT and
+        lands on the ladder's batch_half rung — the run completes."""
+        cfg = dataclasses.replace(
+            _e2e_cfg("fusedoom", str(tmp_path)),
+            round_pipeline="off", fault_spec="dispatch:oom@3")
+        strategy = run_experiment(cfg, sink=None, data=chaos_data,
+                                  train_cfg=tiny_train_config(),
+                                  model=TinyClassifier(num_classes=4))
+        assert strategy.trainer.fused_tx is not None  # the fused path ran
+        assert (_metric_max(cfg.log_dir, "degrade_events") or 0) >= 1
+        jr = journal_lib.read_journal(
+            os.path.join(cfg.log_dir, faults.JOURNAL_FILE))
+        assert jr["status"] == "finished"
+        log = glob.glob(os.path.join(cfg.log_dir, "*.log"))[0]
+        assert "engaging rung 'batch_half'" in open(log).read()
 
 
 # ---------------------------------------------------------------------------

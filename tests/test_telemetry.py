@@ -702,6 +702,74 @@ class TestTraceLint:
         assert tuple(lint._registered_fault_sites(
             lint.FAULTS_REGISTRY, [])) == tuple(faults.SITES)
 
+    def test_lint_flags_backward_registry_violations(self, tmp_path):
+        """The gradient path's proven-backward invariant (check 9,
+        DESIGN.md §4): a jax.custom_vjp outside ops/backward.py, a
+        registry entry with no definition, a PARITY_TESTED_VJPS drift,
+        and host materialization inside a fused-update function must
+        each fail the lint."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_lint", os.path.join(REPO, "scripts", "trace_lint.py"))
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+
+        # a) a custom VJP dodging the registry, flagged on a fragment.
+        stray = tmp_path / "stray_vjp.py"
+        stray.write_text(
+            "import jax\n"
+            "@jax.custom_vjp\n"
+            "def sneaky(x):\n"
+            "    return x\n")
+        problems = lint.check_backward_registry([str(stray)])
+        assert any("custom_vjp outside ops/backward.py" in p
+                   for p in problems)
+
+        # b) registry drift: a registered name with no definition.
+        ops_bad = tmp_path / "ops_bad.py"
+        ops_bad.write_text(
+            "import jax\n"
+            "TRAIN_PATH_VJPS = ('ghost',)\n"
+            "@jax.custom_vjp\n"
+            "def real(x):\n"
+            "    return x\n")
+        problems = lint.check_backward_registry(
+            ops_path=str(ops_bad), optim_path=lint.OPTIM,
+            tests_path=lint.BACKWARD_TESTS)
+        assert any("'ghost'" in p and "no such function" in p
+                   for p in problems)
+
+        # c) a custom backward without a registered parity test.
+        tests_bad = tmp_path / "tests_bad.py"
+        tests_bad.write_text("PARITY_TESTED_VJPS = ('stem_conv',)\n")
+        problems = lint.check_backward_registry(
+            ops_path=lint.OPS_BACKWARD, optim_path=lint.OPTIM,
+            tests_path=str(tests_bad))
+        assert any("PARITY_TESTED_VJPS" in p and "TRAIN_PATH_VJPS" in p
+                   for p in problems)
+
+        # d) host materialization inside a fused-update function.
+        optim_bad = tmp_path / "optim_bad.py"
+        optim_bad.write_text(
+            "import numpy as np\n"
+            "FUSED_UPDATE_FNS = ('fused_sgd_update',)\n"
+            "def fused_sgd_update(grads, state, params, lr):\n"
+            "    host = np.asarray(grads)\n"
+            "    return params, state\n")
+        problems = lint.check_backward_registry(
+            ops_path=lint.OPS_BACKWARD, optim_path=str(optim_bad),
+            tests_path=lint.BACKWARD_TESTS)
+        assert any("references np" in p for p in problems)
+
+        # The REAL tree is clean, and the registered half matches the
+        # tested half (the closed-registry handshake).
+        assert lint.check_backward_registry() == []
+        from active_learning_tpu.ops import backward as backward_ops
+        import importlib
+        tb = importlib.import_module("test_backward")
+        assert set(tb.PARITY_TESTED_VJPS) == \
+            set(backward_ops.TRAIN_PATH_VJPS)
+
 
 class TestSatelliteFixes:
     def test_setup_logging_appends_on_resume(self, tmp_path):
