@@ -391,8 +391,20 @@ class ExperimentConfig:
 
     # Debug
     debug_mode: bool = False
-    # Capture an XLA profiler trace (TensorBoard/XProf) for the run.
+    # The device-truth layer (telemetry/profiler.py, DESIGN.md §11):
+    # bounded XLA profiler capture windows around chosen AL rounds.
+    # profile_dir names where the trace artifacts + per-round
+    # device_profile_rd{n}.json summaries land (set alone it captures
+    # the default window); profile_rounds picks WHICH rounds capture —
+    # a comma-separated list or "warm" (default: round 1, the first
+    # warm round).  Round 0 NEVER captures: it pays the cold compile
+    # tax and its trace would answer "how slow is compilation", not
+    # "where does the steady-state round go".  Setting profile_rounds
+    # without profile_dir lands artifacts under <log_dir>/profile.
+    # Unset, the capture hooks are inert (no per-step or per-round
+    # work — pinned in tests/test_profiler.py).
     profile_dir: Optional[str] = None
+    profile_rounds: Optional[str] = None
 
     # Compute-precision override: None defers to the arg pool's
     # TrainConfig.dtype ("auto" = bf16 on TPU / f32 elsewhere).

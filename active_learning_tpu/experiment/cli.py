@@ -68,7 +68,18 @@ def get_parser() -> argparse.ArgumentParser:
     # Debug (parser.py:70-71)
     p.add_argument("--debug_mode", action="store_true")
     p.add_argument("--profile_dir", type=str, default=None,
-                   help="capture an XLA profiler trace to this directory")
+                   help="device-truth profiling (DESIGN.md §11): bounded "
+                        "XLA profiler capture windows land their trace "
+                        "artifacts + device_profile_rd{n}.json summaries "
+                        "here (set alone: the default warm-round window)")
+    p.add_argument("--profile_rounds", type=str, default=None,
+                   help="which AL rounds get a capture window: a comma-"
+                        "separated list or 'warm' (default: round 1, the "
+                        "first warm round).  Round 0 never captures — it "
+                        "pays the cold compile tax.  Device ops splice "
+                        "into the --export_trace timeline and the "
+                        "device_busy_frac / collective_bytes_total "
+                        "metrics ride the sink + Prometheus")
     # Run-wide telemetry (active_learning_tpu/telemetry/, DESIGN.md §7).
     # Default ON: per-step/per-epoch metrics through the sink + the
     # heartbeat file; trace export and the watchdog are opt-in.
@@ -247,6 +258,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         download_data=args.download_data,
         debug_mode=args.debug_mode,
         profile_dir=args.profile_dir,
+        profile_rounds=args.profile_rounds,
         telemetry=TelemetryConfig(
             enabled=not args.disable_telemetry,
             heartbeat_every_s=args.heartbeat_every_s,

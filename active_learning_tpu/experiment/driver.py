@@ -41,12 +41,13 @@ from ..models.factory import get_network
 from ..parallel import mesh as mesh_lib
 from ..pool import PoolState
 from ..strategies import get_strategy
+from ..telemetry import profiler as tele_profiler
 from ..telemetry import runtime as tele_runtime
 from ..telemetry import spans as tele_spans
 from ..train import checkpoint as ckpt_lib
 from ..utils.logging import get_logger, setup_logging
 from ..utils.metrics import MetricsSink, make_sink
-from ..utils.tracing import phase_timer, profiler_session
+from ..utils.tracing import phase_timer
 from ..train.trainer import Trainer
 from . import arg_pools as arg_pools_lib
 from . import pipeline as pipeline_lib
@@ -367,6 +368,34 @@ def build_experiment(
     return strategy
 
 
+# Every per-round metric the DRIVER emits through the MetricsSink, by
+# name.  The Prometheus scrape file (--prometheus_file) must carry each
+# of these as an ``al_run_`` gauge whenever the driver emitted it that
+# round — the completeness contract tests/test_profiler.py diffs sink
+# names against scrape samples with (the per-epoch trainer/strategy
+# series — step_time, imgs_per_sec, spec_hit_frac — are per-EPOCH or
+# strategy-owned and ride the heartbeat/status path instead).  The
+# device-truth metrics (telemetry/profiler.RoundProfiler.emit_metrics)
+# register dynamically the same way: sink + gauges from one dict.
+PER_ROUND_GAUGES = (
+    "rd_round_time", "overlap_frac", "round_vs_max_phase",
+    "rd_spec_score_time", "jit_cache_miss_delta", "fault_retries_total",
+    "degrade_events", "hbm_peak_gb",
+)
+
+
+def _emit_round_gauges(telemetry, sink: MetricsSink, rd: int,
+                       metrics: dict) -> None:
+    """One dict -> BOTH channels: the metrics sink (per-round history)
+    and the Prometheus gauges (latest-value scrape).  Emitting through
+    one spelling is what makes the scrape-file completeness auditable —
+    a metric added to one channel cannot silently miss the other."""
+    numeric = {k: v for k, v in metrics.items() if v is not None}
+    for name, value in numeric.items():
+        sink.log_metric(name, value, step=rd)
+    telemetry.set_gauges(**numeric)
+
+
 def _emit_overlap_telemetry(telemetry, sink: MetricsSink, rd: int,
                             round_s: float, phase_s: dict,
                             spec_s: float, pipeline_mode: str) -> None:
@@ -389,13 +418,13 @@ def _emit_overlap_telemetry(telemetry, sink: MetricsSink, rd: int,
     longest = max(max(phase_s.values()), spec_s)
     if serial <= 0 or longest <= 0:
         return
-    sink.log_metric("rd_round_time", round(round_s, 3), step=rd)
-    sink.log_metric("overlap_frac",
-                    round(max(0.0, 1.0 - round_s / serial), 4), step=rd)
-    sink.log_metric("round_vs_max_phase", round(round_s / longest, 3),
-                    step=rd)
-    if pipeline_mode != "off":
-        sink.log_metric("rd_spec_score_time", round(spec_s, 3), step=rd)
+    _emit_round_gauges(telemetry, sink, rd, {
+        "rd_round_time": round(round_s, 3),
+        "overlap_frac": round(max(0.0, 1.0 - round_s / serial), 4),
+        "round_vs_max_phase": round(round_s / longest, 3),
+        "rd_spec_score_time": (round(spec_s, 3)
+                               if pipeline_mode != "off" else None),
+    })
 
 
 def _emit_round_telemetry(telemetry, sink: MetricsSink, rd: int,
@@ -411,35 +440,39 @@ def _emit_round_telemetry(telemetry, sink: MetricsSink, rd: int,
     trace export so a crash mid-run still leaves trace.json on disk."""
     if not telemetry.train_metrics:
         return
-    delta = telemetry.jit_cache_delta()
-    sink.log_metric("jit_cache_miss_delta", delta, step=rd)
     # Per-RUN retries: the process counter is cumulative across every
     # run/phase sharing this interpreter (bench runs many), so the
     # run-start baseline is subtracted — the al_round retries rider must
     # attribute only what the measured rounds absorbed.
     retries = faults.retry_counters()
     run_retries = retries["total"] - retries_baseline
-    sink.log_metric("fault_retries_total", run_retries, step=rd)
-    sink.log_metric("degrade_events",
-                    ladder.events if ladder is not None else 0, step=rd)
     hbm = tele_runtime.hbm_high_water_gb()
-    if hbm is not None:
-        sink.log_metric("hbm_peak_gb", hbm, step=rd)
+    # Per-round history + latest-value gauges from ONE dict (the scrape
+    # completeness contract, PER_ROUND_GAUGES).
+    _emit_round_gauges(telemetry, sink, rd, {
+        "jit_cache_miss_delta": telemetry.jit_cache_delta(),
+        "fault_retries_total": run_retries,
+        "degrade_events": ladder.events if ladder is not None else 0,
+        "hbm_peak_gb": hbm,
+    })
     # Feed-boundedness gauges from the round's fit (trainer.last_feed):
     # a host-bound warm round reads off the Prometheus scrape / `status`
     # without a profiler.  feed_source is non-numeric, so it rides the
     # heartbeat detail instead (the trainer ticks `feed=` every epoch;
-    # `status` renders it).
+    # `status` renders it).  The span-buffer drop counter rides here
+    # too: a capped trace silently truncates evidence, and the only
+    # place that shows is the tracer's own counter — nonzero
+    # al_run_span_events_dropped on a scrape means trace.json is no
+    # longer the whole story.
     feed = strategy.trainer.last_feed
     telemetry.set_gauges(
         round=rd, cumulative_budget=strategy.pool.cumulative_cost,
         labeled=strategy.pool.num_labeled,
         jit_cache_total=telemetry.jit_cache_total(),
-        hbm_peak_gb=hbm,
-        fault_retries_total=run_retries,
         degrade_active=(len(ladder.active) if ladder is not None else 0),
         feed_stall_frac=feed.get("feed_stall_frac"),
-        host_wait_ms_p50=feed.get("host_wait_ms_p50"))
+        host_wait_ms_p50=feed.get("host_wait_ms_p50"),
+        span_events_dropped=tele_spans.get_tracer().dropped)
     telemetry.write_prometheus()
     telemetry.export_trace()
     telemetry.tick(force=True, phase="round_end", round=rd)
@@ -510,6 +543,24 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     with per-phase wall-clock timers (the reference prints them,
     main_al.py:160-178; here they also land in the metrics sink).
     """
+    # Device-truth profiling (telemetry/profiler.py, DESIGN.md §11):
+    # when capture windows are armed, the HLO byte-table dump must be
+    # pointed at its sidecar dir BEFORE the first backend touch — XLA
+    # latches XLA_FLAGS at backend init, and the rendezvous below is
+    # that first touch.  Env-only here (no logger yet); the
+    # RoundProfiler itself is built after logging setup.
+    profiling_armed = bool(cfg.profile_rounds or cfg.profile_dir)
+    profile_dir = hlo_dump_dir = None
+    # XLA_FLAGS is restored at run exit: XLA latched it at backend init,
+    # so the env var is dead weight for THIS process afterwards — but a
+    # leaked --xla_dump_to would arm dumping in every later subprocess
+    # (bench children, status probes) against a dir this run owns.
+    prev_xla_flags = os.environ.get("XLA_FLAGS")
+    if profiling_armed:
+        profile_dir = cfg.profile_dir or os.path.join(cfg.log_dir,
+                                                      "profile")
+        hlo_dump_dir = tele_profiler.arm_hlo_dump(
+            os.path.join(profile_dir, "hlo"))
     # Multi-host rendezvous first — nothing above this may touch a JAX
     # backend.  A no-op unless the config carries the multi-host fields.
     mesh_lib.initialize_distributed(cfg.coordinator_address,
@@ -548,6 +599,44 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
     if fault_spec:
         logger.warning(f"fault injection ARMED: {fault_spec} "
                        f"(seed {cfg.run_seed}); disarmed at run exit")
+
+    # The per-round capture windows (coordinator only: one process's
+    # profiler session; pod-wide capture is a ROADMAP pod-tier item).
+    # Unarmed, round_profiler stays None and the loop's hook is a null
+    # context — zero per-round work (tests/test_profiler.py bounds it).
+    round_profiler = None
+    if profiling_armed and mesh_lib.is_coordinator():
+        rounds, rejected = tele_profiler.parse_profile_rounds(
+            cfg.profile_rounds)
+        if rejected:
+            logger.warning(
+                f"profiler: --profile_rounds entries {rejected} ignored "
+                "(round 0 pays the cold compile tax and never captures; "
+                "rounds are positive integers)")
+        reachable = [r for r in rounds if r < cfg.rounds]
+        if not reachable:
+            # e.g. --profile_dir on a rounds=1 run: the default warm
+            # window (round 1) does not exist.  Say so and arm NOTHING
+            # — a "capture armed" log followed by an empty profile_dir
+            # would read as a profiler bug, not a config gap.
+            logger.warning(
+                f"profiler: no selected round {list(rounds)} exists in "
+                f"a {cfg.rounds}-round run — nothing will be captured "
+                "(round 0 never captures; run >= 2 rounds or pass "
+                "--profile_rounds inside the run)")
+        else:
+            if len(reachable) < len(rounds):
+                logger.warning(
+                    "profiler: rounds "
+                    f"{[r for r in rounds if r >= cfg.rounds]} exceed "
+                    f"the {cfg.rounds}-round run and will not capture")
+            round_profiler = tele_profiler.RoundProfiler(
+                profile_dir, rounds=reachable, hlo_dump_dir=hlo_dump_dir,
+                logger=logger)
+            logger.info(
+                f"profiler: device-truth capture armed for rounds "
+                f"{reachable} -> {profile_dir} "
+                f"(HLO byte table: {hlo_dump_dir or 'unavailable'})")
 
     resuming = cfg.resume_training and resume_lib.has_saved_experiment(cfg)
     preempted_round0 = False
@@ -827,10 +916,9 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
                               labeled_crc=_labeled_crc(strategy.pool))
             return phase_s, round_sp
 
-        with profiler_session(cfg.profile_dir), \
-                tele_spans.get_tracer().span(
-                    "experiment", args={"exp_name": cfg.exp_name,
-                                        "exp_hash": cfg.exp_hash}):
+        with tele_spans.get_tracer().span(
+                "experiment", args={"exp_name": cfg.exp_name,
+                                    "exp_hash": cfg.exp_hash}):
             for rd in range(start_round, cfg.rounds):
                 preempt_lib.check()
                 # Degradation is per-round: every round starts at full
@@ -840,7 +928,18 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
                 snapshot = _round_snapshot(strategy)
                 for attempt in range(ladder.max_attempts()):
                     try:
-                        phase_s, round_sp = _run_round(rd, attempt)
+                        # The device-truth capture window (DESIGN.md
+                        # §11): a selected WARM round runs inside one
+                        # jax.profiler window; on exit the device ops
+                        # splice into the span trace and the
+                        # device_busy_frac / collective_bytes metrics
+                        # emit.  Inside the try: a failed attempt stops
+                        # the trace on its way to the ladder.
+                        with tele_profiler.round_scope(
+                                round_profiler, rd,
+                                tracer=tele_spans.get_tracer(),
+                                sink=sink, telemetry=telemetry):
+                            phase_s, round_sp = _run_round(rd, attempt)
                         break
                     except preempt_lib.PreemptionRequested:
                         raise
@@ -895,6 +994,12 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
             "--resume_training to continue bit-identically")
         raise
     finally:
+        if profiling_armed:
+            # Un-leak the HLO dump arming (see prev_xla_flags above).
+            if prev_xla_flags is None:
+                os.environ.pop("XLA_FLAGS", None)
+            else:
+                os.environ["XLA_FLAGS"] = prev_xla_flags
         if fault_spec:
             # Disarm only what THIS run armed (cleanup runs fault-free;
             # a programmatic arming by the caller is left alone).

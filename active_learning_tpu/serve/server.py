@@ -10,6 +10,18 @@ Endpoints:
   POST /v1/score     same request schema (+ optional "embedding": true)
                      -> {"round", "scores": [{"pred", "confidence",
                          "margin", "entropy"}], "embedding"?: [[...]]}
+  POST /v1/profile   {"seconds": 1.0} -> a BOUNDED device-truth capture
+                     window under live load (telemetry/profiler.py,
+                     the one gated jax.profiler API): the window opens,
+                     traffic keeps flowing, and the response carries
+                     device_busy_frac / collective_frac /
+                     per-primitive collective counts plus the trace +
+                     summary paths (artifacts land in a SERVER-chosen
+                     temp dir named in the response — no client-chosen
+                     path, no remote filesystem-write primitive).  One
+                     window at a time (409 while one is open); seconds
+                     clamped to MAX_SERVE_CAPTURE_S; a window that
+                     produces no trace is a 500, never a 200.
   GET  /healthz      liveness + the served round, bucket ladder, and
                      image shape (the loadgen reads the shape here)
   GET  /metrics      ServeMetrics snapshot + executor/batcher state,
@@ -192,6 +204,11 @@ class ScoringServer:
                 if self._draining:
                     raise _HttpError(503, "server is draining")
                 return await self._score(path, body)
+            if method == "POST" and path == "/v1/profile":
+                self.metrics.record_request(path)
+                if self._draining:
+                    raise _HttpError(503, "server is draining")
+                return await self._profile(body)
             raise _HttpError(404, f"no route for {method} {path}")
         except _HttpError as e:
             return e.status, {"error": e.message}, e.headers
@@ -244,6 +261,58 @@ class ScoringServer:
             resp["embedding"] = np.asarray(
                 out["embedding"], dtype=np.float64).tolist()
         return 200, resp, {}
+
+    async def _profile(self, body: bytes) -> Tuple[int, Dict,
+                                                   Dict[str, str]]:
+        """A bounded device-truth capture under live load.  The blocking
+        window (open -> sleep -> close -> parse) runs in a worker thread
+        so the event loop keeps serving THROUGH the window — that live
+        traffic is exactly what the capture exists to observe.  One
+        window at a time process-wide (the profiler's own gate); a
+        second request while one is open gets 409.  Capture overhead is
+        real: the profiler's python tracer slows every request served
+        during the window and trace parse time grows with traffic —
+        exactly why windows are seconds-clamped and one-at-a-time (an
+        ops probe, not a monitoring mode)."""
+        import tempfile
+
+        from ..telemetry import profiler as profiler_lib
+
+        req = _parse_json(body)
+        seconds = req.get("seconds", 1.0)
+        if isinstance(seconds, bool) or not isinstance(seconds,
+                                                       (int, float)) \
+                or not seconds > 0:
+            raise _HttpError(400, "seconds must be a positive number "
+                                  f"(<= {profiler_lib.MAX_SERVE_CAPTURE_S}"
+                                  ", clamped)")
+        if "dir" in req:
+            # No client-chosen output path: every other endpoint never
+            # writes files, and a server on a non-loopback host must
+            # not hand remote callers a filesystem-write primitive.
+            # The response names where the artifacts landed.
+            raise _HttpError(400, "dir is not accepted; artifacts land "
+                                  "in a server-chosen directory named "
+                                  "in the response")
+        out_dir = tempfile.mkdtemp(prefix="al_serve_profile_")
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, profiler_lib.serve_capture, out_dir, float(seconds))
+        except profiler_lib.CaptureBusyError as e:
+            raise _HttpError(409, str(e))
+        if not result.get("ok"):
+            # The window opened but produced nothing to parse: a failed
+            # capture must be status-coded like every other error here,
+            # not a 200 an ops script would read as success.
+            self.logger.warning(
+                f"serve: profile window failed: {result.get('error')}")
+            return 500, result, {}
+        self.logger.info(
+            f"serve: profile window captured -> {out_dir} "
+            f"(busy={result.get('device_busy_frac')}, "
+            f"collective={result.get('collective_frac')})")
+        return 200, result, {}
 
     def _healthz(self) -> Dict:
         return {
@@ -319,8 +388,8 @@ def _write_response(writer: asyncio.StreamWriter, status: int,
                     payload, extra_headers: Dict[str, str],
                     keep_alive: bool) -> None:
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-              413: "Payload Too Large", 429: "Too Many Requests",
-              500: "Internal Server Error",
+              409: "Conflict", 413: "Payload Too Large",
+              429: "Too Many Requests", 500: "Internal Server Error",
               503: "Service Unavailable"}.get(status, "")
     extra_headers = dict(extra_headers)
     if isinstance(payload, str):

@@ -20,6 +20,14 @@ Four pieces, one install point (DESIGN.md §7):
 ``status.py`` is the read side: the ``status`` CLI verb renders a live
 run summary from heartbeat + metrics.jsonl with no jax import.
 
+``profiler.py`` is the DEVICE-truth layer on top (DESIGN.md §11):
+bounded ``jax.profiler`` capture windows (never whole runs, never round
+0), device-op classification + collective-bytes accounting, and the
+merged host+device Chrome timeline.  It is the only module allowed to
+touch ``jax.profiler`` (trace_lint check 10) and is deliberately NOT
+re-exported here — its parsing half imports no jax and is used from
+hosts that could never initialize a backend.
+
 Default-on at negligible cost: per-step collection is two perf_counter
 calls and a list append; heartbeat ticks are a lock + monotonic compare
 when rate-limited.  Trace export and the watchdog are opt-in

@@ -155,6 +155,34 @@ class SpanTracer:
                 **({"args": dict(args)} if args else {}),
             })
 
+    def snapshot_events(self) -> List[Dict[str, Any]]:
+        """A consistent copy of the recorded events (the profiler's
+        per-phase attribution intersects device ops with the host phase
+        spans recorded here)."""
+        with self._lock:
+            return list(self.events)
+
+    @property
+    def origin(self) -> float:
+        """The perf_counter stamp exported ts values are relative to —
+        the device-truth profiler re-bases spliced device events onto
+        this axis (telemetry/profiler.splice_into_tracer)."""
+        return self._origin
+
+    def splice_events(self, events: List[Dict[str, Any]]) -> int:
+        """Append pre-built Chrome events (the profiler's re-based
+        device tracks) to the export buffer.  Not counted against
+        ``max_events``: the splice is bounded by the profiler's own cap
+        (MAX_SPLICED_EVENTS) and dropping host spans to make room for
+        device ops — or vice versa — would orphan one half of the very
+        merge the splice exists for.  Returns the number appended (0
+        when recording is off)."""
+        if not self.enabled:
+            return 0
+        with self._lock:
+            self.events.extend(events)
+        return len(events)
+
     def _record(self, sp: Span) -> None:
         event = {
             "name": sp.name, "ph": "X", "cat": "host",

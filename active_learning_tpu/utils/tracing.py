@@ -6,17 +6,18 @@ Here the same per-phase timers are HOST SPANS (telemetry/spans.py): one
 measurement feeds the ``rd_{name}`` metric, the log line, the Chrome
 trace event, and the heartbeat tick, so the trace can never silently
 fork from the metrics (scripts/trace_lint.py asserts this routing).
-Each phase additionally wraps a ``jax.profiler.TraceAnnotation`` so
-device traces show query/train/test spans, and an opt-in
-``profile_dir`` captures a full XLA profiler trace (TensorBoard/XProf)
-for the whole run.
+Each phase additionally wraps a device trace annotation so XLA profiler
+captures (telemetry/profiler.py — the device-truth layer, which owns
+EVERY jax.profiler touch per trace_lint check 10) show query/train/test
+spans on the device timeline too.
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+from typing import Iterator
 
+from ..telemetry import profiler as _tele_profiler
 from ..telemetry import runtime as _tele_runtime
 from ..telemetry import spans as _tele_spans
 from .logging import get_logger
@@ -25,9 +26,10 @@ from .logging import get_logger
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
     """Name the enclosed host span in device profiler traces; free when no
-    trace is active."""
-    import jax.profiler
-    with jax.profiler.TraceAnnotation(name):
+    trace is active.  Delegates to the device-truth layer's gated
+    annotation (telemetry/profiler.trace_annotation) — one module owns
+    jax.profiler."""
+    with _tele_profiler.trace_annotation(name):
         yield
 
 
@@ -53,18 +55,3 @@ def phase_timer(name: str, round_idx: int, sink=None,
         sink.log_metric(f"rd_{name}", seconds, step=round_idx)
 
 
-@contextlib.contextmanager
-def profiler_session(profile_dir: Optional[str]) -> Iterator[None]:
-    """Capture an XLA profiler trace under ``profile_dir`` (None = no-op).
-    View with TensorBoard's profile plugin / XProf."""
-    if not profile_dir:
-        yield
-        return
-    import jax.profiler
-    get_logger().info(f"Capturing profiler trace to {profile_dir}")
-    jax.profiler.start_trace(profile_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-        get_logger().info(f"Profiler trace written to {profile_dir}")

@@ -328,6 +328,41 @@ def check_consistency(out: dict, tol: float = CONSISTENCY_TOL) -> dict:
     return consistency
 
 
+def device_truth_crosscheck(out: dict, profile_path: str) -> dict:
+    """The device-truth cross-check column (ISSUE 11): fold a driver
+    capture summary (telemetry/profiler.py's device_profile_rd{n}.json)
+    into the decomposition evidence.  The decomposition's host timings
+    say how long each variant TOOK; the capture says what the device
+    DID during a real round — busy fraction, collective share, measured
+    collective bytes.  A host-derived mfu far above device_busy_frac
+    means the host timer flattered the device (dispatch gaps hidden by
+    async); far below means the device idled on host stalls the
+    decomposition never sees.  Stored verbatim + derived deltas, never
+    merged into the host numbers."""
+    with open(profile_path) as fh:
+        capture = json.load(fh)
+    cross = {
+        "source": profile_path,
+        "round": capture.get("round"),
+        "device_busy_frac": capture.get("device_busy_frac"),
+        "collective_frac": capture.get("collective_frac"),
+        "transfer_frac": capture.get("transfer_frac"),
+        "collective_bytes_total": capture.get("collective_bytes_total"),
+    }
+    train = out.get("timings", {}).get("train_full", {})
+    host_mfu = train.get("mfu")
+    busy = capture.get("device_busy_frac")
+    if host_mfu is not None and busy:
+        # MFU <= busy always (you cannot achieve flops while idle); the
+        # gap busy − mfu is the device-side inefficiency (low-occupancy
+        # kernels, collectives), while 1 − busy is the HOST-side gap.
+        cross["host_mfu_train_full"] = host_mfu
+        cross["device_side_gap"] = round(busy - host_mfu, 3)
+        cross["host_side_gap"] = round(1.0 - busy, 3)
+    out["device_truth"] = cross
+    return cross
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-per-chip", type=int, default=128)
@@ -337,6 +372,11 @@ def main():
                          "for CPU schema-regeneration runs)")
     ap.add_argument("--consistency-tol", type=float,
                     default=CONSISTENCY_TOL)
+    ap.add_argument("--device_profile", type=str, default=None,
+                    help="a device_profile_rd{n}.json from a "
+                         "--profile_rounds driver run: folded in as the "
+                         "device-truth cross-check column "
+                         "(device_busy_frac vs host-derived mfu)")
     ap.add_argument("--out", default=os.path.join(
         REPO, "mfu_decomposition.json"))
     args = ap.parse_args()
@@ -398,6 +438,16 @@ def main():
             out["bwd_mfu"] = round(tf_bwd / peak, 3)
     out["opt_update_ms"] = t["optimizer_update"]["ms_per_update"]
     check_consistency(out, tol=args.consistency_tol)
+    if args.device_profile:
+        try:
+            cross = device_truth_crosscheck(out, args.device_profile)
+            print(f"[device_truth] busy={cross.get('device_busy_frac')} "
+                  f"collective={cross.get('collective_frac')} "
+                  f"bytes={cross.get('collective_bytes_total')}",
+                  file=sys.stderr)
+        except (OSError, ValueError) as e:
+            print(f"[device_truth] cross-check unavailable: {e!r}",
+                  file=sys.stderr)
     out["gf_per_image_source"] = "bench.py device-cost-analysis (r5)"
     out["gf_note"] = ("train_frozen_bn reuses the full-BN 23.91 GF/img "
                       "(no separate cost-analysis capture); its achieved "

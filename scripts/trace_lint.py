@@ -15,7 +15,8 @@ routing statically, invoked from tier-1 (tests/test_telemetry.py):
   3. Every module calling ``phase_timer(`` must import it from
      ``utils.tracing`` — no copies, no local re-implementations.
   4. ``jax.profiler.TraceAnnotation`` stays behind ``tracing.annotate``
-     (one device-naming convention; the whitelist is tracing.py).
+     (one device-naming convention; the whitelist is the device-truth
+     layer, telemetry/profiler.py, which tracing.annotate delegates to).
 
 It also enforces the trainer's ZERO-HOST-COPY feed invariant (the
 resident-gather train feed, DESIGN.md §2a):
@@ -78,6 +79,22 @@ the fused optimizer, DESIGN.md §4):
      (``np.*`` references, ``.asarray``/``device_get``/
      ``block_until_ready`` calls).
 
+... and the device-truth layer's ONE-GATE invariant (bounded profiler
+capture windows, DESIGN.md §11):
+
+  10. ``jax.profiler`` may only be imported or invoked inside
+      ``telemetry/profiler.py`` — no ``import jax.profiler`` /
+      ``from jax import profiler``, no ``jax.profiler`` attribute
+      access, and no ``start_trace``/``stop_trace`` call (under ANY
+      alias) anywhere else.  Every capture window goes through the
+      gated API (``capture_window``/``start_capture``/
+      ``finish_capture``), which is what makes "one capture at a time,
+      always stopped on failure, always merged and classified" a
+      property of the system instead of a convention — and the gate
+      module itself must define those entry points and actually touch
+      jax.profiler (a renamed-away gate would make the check vacuous).
+      A closed registry like checks 8 and 9.
+
 Stdlib only; exits 0 clean / 1 with findings on stderr.
 """
 
@@ -91,9 +108,20 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "active_learning_tpu")
 TRACING = os.path.join(PKG, "utils", "tracing.py")
+PROFILER = os.path.join(PKG, "telemetry", "profiler.py")
 
-# The one module allowed to touch jax.profiler.TraceAnnotation directly.
-ANNOTATION_WHITELIST = {TRACING}
+# The one module allowed to touch jax.profiler (TraceAnnotation included):
+# the device-truth layer.  tracing.annotate delegates here.
+ANNOTATION_WHITELIST = {PROFILER}
+
+# Capture-window entry points: calling either outside the gate module —
+# under any alias — dodges the one-capture-at-a-time/always-stopped/
+# always-merged contract.
+_CAPTURE_CALLS = {"start_trace", "stop_trace"}
+# The gated API the gate module must define (a renamed-away gate would
+# make check 10 vacuous).
+_PROFILER_GATE_FNS = ("start_capture", "finish_capture", "capture_window",
+                      "trace_annotation")
 
 TRAINER = os.path.join(PKG, "train", "trainer.py")
 # The trainer functions that ARE the resident-gather feed path: each must
@@ -250,6 +278,10 @@ def check() -> list:
     # optimizer update never touches the host.
     problems.extend(check_backward_registry())
 
+    # 10. jax.profiler stays confined to the device-truth layer and
+    # every capture window goes through its gated API.
+    problems.extend(check_profiler_confinement())
+
     return problems
 
 
@@ -390,6 +422,95 @@ def check_backward_registry(files=None, ops_path: str = OPS_BACKWARD,
                     f"{rel_optim}:{node.lineno}: {name} calls "
                     f".{node.func.attr}() — host materialization inside "
                     "the fused optimizer update")
+    return problems
+
+
+def check_profiler_confinement(files=None,
+                               profiler_path: str = PROFILER) -> list:
+    """The device-truth layer's one-gate invariant, statically
+    (check 10): ``jax.profiler`` imports/attribute access and
+    ``start_trace``/``stop_trace`` calls are confined to
+    telemetry/profiler.py, and that module really defines the gated API
+    and touches jax.profiler.  ``files`` given = a negative-case unit
+    test on a fragment (the confinement scan only)."""
+    problems = []
+    full_tree = files is None
+    paths = list(_py_files()) if full_tree else list(files)
+    for path in paths:
+        if os.path.abspath(path) == os.path.abspath(profiler_path):
+            continue
+        rel = os.path.relpath(path, REPO)
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            problems.append(f"{rel}: unreadable for the profiler-"
+                            f"confinement check ({e})")
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax.profiler" \
+                            or alias.name.startswith("jax.profiler."):
+                        problems.append(
+                            f"{rel}:{node.lineno}: imports jax.profiler "
+                            "outside telemetry/profiler.py — capture "
+                            "windows and device annotations go through "
+                            "the gated API (DESIGN.md §11)")
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if (node.module == "jax"
+                        and any(a.name == "profiler"
+                                for a in node.names)) \
+                        or node.module.startswith("jax.profiler"):
+                    problems.append(
+                        f"{rel}:{node.lineno}: imports jax's profiler "
+                        "outside telemetry/profiler.py — use the gated "
+                        "API")
+            if isinstance(node, ast.Attribute) \
+                    and node.attr == "profiler" \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "jax":
+                problems.append(
+                    f"{rel}:{node.lineno}: touches jax.profiler outside "
+                    "telemetry/profiler.py — the device-truth layer is "
+                    "the one gate")
+            if isinstance(node, ast.Call):
+                fn = node.func
+                called = (fn.attr if isinstance(fn, ast.Attribute)
+                          else fn.id if isinstance(fn, ast.Name) else "")
+                if called in _CAPTURE_CALLS:
+                    problems.append(
+                        f"{rel}:{node.lineno}: calls {called}() outside "
+                        "telemetry/profiler.py — every capture window "
+                        "goes through the gated API (capture_window/"
+                        "start_capture/finish_capture)")
+    if not full_tree:
+        return problems
+
+    # The gate module itself: the API exists and jax.profiler is really
+    # touched (otherwise the confinement above confines nothing).
+    rel = os.path.relpath(profiler_path, REPO)
+    try:
+        with open(profiler_path) as fh:
+            gate_tree = ast.parse(fh.read())
+    except (OSError, SyntaxError) as e:
+        return problems + [f"{rel}: unreadable for the profiler-gate "
+                           f"check ({e})"]
+    defs = {n.name for n in ast.walk(gate_tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name in _PROFILER_GATE_FNS:
+        if name not in defs:
+            problems.append(
+                f"{rel}: gated API function {name} not found — the "
+                "capture-window enforcement has nothing to point at")
+    touches = any(
+        isinstance(n, ast.Import) and any(
+            a.name == "jax.profiler" for a in n.names)
+        for n in ast.walk(gate_tree))
+    if not touches:
+        problems.append(
+            f"{rel}: never imports jax.profiler — the gate module is "
+            "not actually the gate")
     return problems
 
 

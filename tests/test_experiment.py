@@ -232,17 +232,27 @@ def test_resume_skips_completed_rounds(tmp_path):
                                   strategy_1.pool.labeled)
 
 
-def test_profile_dir_captures_xla_trace(tmp_path):
-    """--profile_dir wraps the whole run in a jax.profiler trace
-    (utils/tracing.py profiler_session); the capture must produce trace
-    artifacts on disk."""
+def test_profile_dir_captures_bounded_round_window(tmp_path):
+    """--profile_dir arms the device-truth layer's BOUNDED capture
+    (telemetry/profiler.py, DESIGN.md §11): the default warm-round
+    window (round 1) produces trace artifacts + the classification
+    summary, and round 0 — the compile-tax round — never captures.
+    (The pre-ISSUE-11 behavior wrapped the WHOLE run in one trace;
+    that multi-hour-capture footgun is gone by design.)"""
     profile_dir = tmp_path / "trace"
-    cfg = _cfg(tmp_path, "prof", rounds=1, strategy="RandomSampler",
+    cfg = _cfg(tmp_path, "prof", rounds=2, strategy="RandomSampler",
                profile_dir=str(profile_dir))
     _run(cfg, tmp_path, "prof")
-    names = [f for _, _, fs in os.walk(profile_dir) for f in fs]
-    assert any("trace" in f or f.endswith(".pb") or f.endswith(".json.gz")
+    round1 = profile_dir / "round_1"
+    names = [f for _, _, fs in os.walk(round1) for f in fs]
+    assert any(f.endswith(".trace.json.gz") or f.endswith(".pb")
                for f in names), names
+    assert (round1 / "device_profile_rd1.json").exists()
+    summary = json.loads((round1 / "device_profile_rd1.json").read_text())
+    assert summary["round"] == 1
+    assert summary["device_op_count"] > 0
+    # Never round 0 (its trace would answer "how slow is compilation").
+    assert not (profile_dir / "round_0").exists()
 
 
 class TestGenJobs:

@@ -702,6 +702,64 @@ class TestTraceLint:
         assert tuple(lint._registered_fault_sites(
             lint.FAULTS_REGISTRY, [])) == tuple(faults.SITES)
 
+    def test_lint_flags_stray_jax_profiler_use(self, tmp_path):
+        """The device-truth layer's one-gate invariant (check 10,
+        DESIGN.md §11): importing jax.profiler, touching the
+        jax.profiler attribute, or calling start_trace/stop_trace under
+        ANY alias outside telemetry/profiler.py must each fail the
+        lint — and the gate module itself must define the gated API and
+        really import jax.profiler (the closed-registry handshake,
+        matching check 9)."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_lint", os.path.join(REPO, "scripts", "trace_lint.py"))
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+
+        bad = tmp_path / "rogue_profiler.py"
+        bad.write_text(
+            "import jax.profiler\n"                      # direct import
+            "from jax import profiler as jp\n"           # aliased import
+            "def capture(d):\n"
+            "    jax.profiler.start_trace(d)\n"          # attr + call
+            "    jp.stop_trace()\n"                      # aliased call
+            "def fine():\n"
+            "    from active_learning_tpu.telemetry import profiler\n"
+            "    with profiler.capture_window('/tmp/x'):\n"
+            "        pass\n")
+        problems = lint.check_profiler_confinement([str(bad)])
+        assert any("imports jax.profiler" in p for p in problems)
+        assert any("imports jax's profiler" in p for p in problems)
+        assert any("touches jax.profiler" in p for p in problems)
+        assert any("start_trace()" in p for p in problems)
+        assert any("stop_trace()" in p for p in problems)
+        # The gated-API path is clean — exactly the rogue uses flag.
+        clean = tmp_path / "clean_caller.py"
+        clean.write_text(
+            "from active_learning_tpu.telemetry import profiler\n"
+            "def go(d):\n"
+            "    with profiler.capture_window(d):\n"
+            "        pass\n")
+        assert lint.check_profiler_confinement([str(clean)]) == []
+
+        # A renamed-away gate makes the check vacuous: full-tree mode
+        # verifies the module defines the API and touches jax.profiler.
+        hollow = tmp_path / "hollow_gate.py"
+        hollow.write_text("def unrelated():\n    pass\n")
+        orig = lint._py_files
+        try:
+            lint._py_files = lambda: [str(clean)]
+            problems = lint.check_profiler_confinement(
+                profiler_path=str(hollow))
+        finally:
+            lint._py_files = orig
+        assert any("gated API function" in p and "not found" in p
+                   for p in problems)
+        assert any("never imports jax.profiler" in p for p in problems)
+
+        # The REAL tree is clean against the REAL gate.
+        assert lint.check_profiler_confinement() == []
+
     def test_lint_flags_backward_registry_violations(self, tmp_path):
         """The gradient path's proven-backward invariant (check 9,
         DESIGN.md §4): a jax.custom_vjp outside ops/backward.py, a
