@@ -1,0 +1,34 @@
+"""Whole-package static analysis (DESIGN.md §12).
+
+One engine, one parse per file, 14 checks: the 10 invariants the old
+``scripts/trace_lint.py`` monolith enforced (ported verbatim — same
+verdicts, same messages) plus four deep checkers targeting the bug
+classes three consecutive PRs of code review kept re-finding:
+
+  lock-discipline    _GUARDED_BY fields only touched under their lock
+  donation-safety    no use-after-donate of donated jit buffers
+  recompile-hazard   jit confined to step-builders, no fresh statics
+  collective-axis    collectives name registered mesh axes; owner_rows
+                     is the one masked-psum spelling
+
+Entry points: ``scripts/al_lint.py`` (CLI: --check/--list/--json),
+``scripts/trace_lint.py`` (the legacy compatibility shim), and
+``run_package_analysis()`` below for programmatic use (the tier-1
+fail-fast test).  Stdlib only — no jax anywhere in this package.
+"""
+
+from __future__ import annotations
+
+from .engine import AstCache, Checker, Context, Engine, default_files
+from .findings import Finding, Report
+
+
+def run_package_analysis(check_ids=None, files=None) -> Report:
+    """Run the full registry (or a subset) over the package tree."""
+    from .checks import CHECKERS
+
+    return Engine(files=files).run(CHECKERS, check_ids=check_ids)
+
+
+__all__ = ["AstCache", "Checker", "Context", "Engine", "Finding",
+           "Report", "default_files", "run_package_analysis"]

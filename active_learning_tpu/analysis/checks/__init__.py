@@ -1,0 +1,25 @@
+"""The checker registry: 10 ported legacy checks + 4 deep checkers.
+
+Ordered — the CLI lists and runs them in this order, and the per-check
+fixture test parametrizes over it.  Adding a check = appending here
+(see engine.py's module docstring for the recipe).
+"""
+
+from __future__ import annotations
+
+from .legacy import LEGACY_CHECKERS
+from .lock_discipline import LockDisciplineChecker
+from .donation import DonationSafetyChecker
+from .recompile import RecompileHazardChecker
+from .collective_axis import CollectiveAxisChecker
+
+DEEP_CHECKERS = (
+    LockDisciplineChecker(),
+    DonationSafetyChecker(),
+    RecompileHazardChecker(),
+    CollectiveAxisChecker(),
+)
+
+CHECKERS = tuple(LEGACY_CHECKERS) + DEEP_CHECKERS
+
+CHECK_IDS = tuple(c.id for c in CHECKERS)

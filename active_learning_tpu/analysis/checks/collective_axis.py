@@ -1,0 +1,244 @@
+"""collective-axis: every collective names a registered mesh axis, and
+the owner-gather idiom has ONE spelling.
+
+A ``psum`` over a typo'd axis name raises at trace time *on the path
+that traces it* — which for rarely-taken branches (a fallback leg, a
+pod-only path) is a multi-host incident, not a unit-test red.  And PR
+6's review had to impose by hand that the masked-psum owner-gather
+idiom (select rows by ownership mask, psum the zeros-or-value result)
+is spelled exactly once, in ``parallel/mesh.owner_rows`` — a second
+hand-rolled copy is where the exactness contract (non-owners contribute
+exact zeros) silently erodes.
+
+Rules:
+
+  * the axis registry is ``parallel/mesh.py``'s module-level
+    ``*_AXIS = "literal"`` constants (today: ``DATA_AXIS = "data"``);
+  * every call to a named collective (``psum``/``psum_scatter``/
+    ``pmax``/``pmin``/``pmean``/``ppermute``/``all_gather``/
+    ``all_to_all``/``axis_index``) must name its axis as: a registered
+    string literal; a reference to a registered constant
+    (``DATA_AXIS``/``mesh_lib.DATA_AXIS``); a local name bound (param
+    default or assignment in an enclosing function) to one of those; a
+    pass-through parameter named ``axis``/``axis_name`` (forwarding
+    helpers like ``owner_rows`` — their call sites are checked
+    instead); or a configured ``*.axis_name`` attribute (flax modules
+    carry the axis as a field, threaded from the step builder).
+    Anything else — an unregistered literal, an unresolvable
+    expression — is a finding;
+  * a ``psum`` whose operand is a name assigned from ``jnp.where(...)``
+    in the same function is the masked owner-gather idiom: allowed only
+    inside ``parallel/mesh.owner_rows`` — everywhere else the fix hint
+    is to call ``mesh_lib.owner_rows``.
+
+Suppression: ``# al-lint: axis-ok <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import Checker, Context, PKG
+from ..findings import Finding
+
+MESH_PATH = os.path.join(PKG, "parallel", "mesh.py")
+
+COLLECTIVES = ("psum", "psum_scatter", "pmax", "pmin", "pmean",
+               "ppermute", "all_gather", "all_to_all", "axis_index")
+
+# Which positional argument carries the axis name, per primitive.
+_AXIS_ARG_POS = {name: 1 for name in COLLECTIVES}
+_AXIS_ARG_POS["axis_index"] = 0
+_AXIS_KEYWORDS = ("axis_name", "axis")
+
+_FORWARD_PARAM_NAMES = {"axis", "axis_name"}
+
+
+def load_axis_registry(tree) -> Tuple[Set[str], Set[str]]:
+    """(registered axis values, registered constant names) from
+    parallel/mesh.py's module body: ``NAME_AXIS = "literal"``."""
+    values: Set[str] = set()
+    names: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.endswith("_AXIS"):
+                    values.add(node.value.value)
+                    names.add(t.id)
+    return values, names
+
+
+class CollectiveAxisChecker(Checker):
+    id = "collective-axis"
+    title = ("collectives name a registered mesh axis; owner-gather is "
+             "spelled via mesh_lib.owner_rows")
+    suppress_token = "axis-ok"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        problems: List[Finding] = []
+        mesh_tree, err = ctx.tree(MESH_PATH)
+        if err is not None:
+            return [Finding(
+                check=self.id, path=ctx.rel(MESH_PATH), line=0,
+                message=f"unreadable axis registry ({err})")]
+        values, const_names = load_axis_registry(mesh_tree)
+        if not values:
+            problems.append(Finding(
+                check=self.id, path=ctx.rel(MESH_PATH), line=0,
+                message="no *_AXIS = \"...\" constants found — the axis "
+                        "registry is empty, every collective would be "
+                        "unresolvable",
+                hint="declare the mesh axes as module-level *_AXIS "
+                     "string constants in parallel/mesh.py"))
+            return problems
+        for path in ctx.files:
+            tree, perr = ctx.tree(path)
+            if perr is not None:
+                continue
+            self._check_module(tree, ctx.rel(path), path, values,
+                               const_names, problems)
+        return problems
+
+    # -- axis resolution --------------------------------------------------
+
+    def _resolves(self, expr, values, const_names, fn_stack) -> bool:
+        """Can ``expr`` be shown to denote a registered axis?"""
+        if isinstance(expr, ast.Constant):
+            return isinstance(expr.value, str) and expr.value in values
+        if isinstance(expr, ast.Name):
+            if expr.id in const_names:
+                return True
+            # A local binding or parameter default in any enclosing
+            # function scope.
+            for fn in reversed(fn_stack):
+                res = self._name_binding(fn, expr.id, values, const_names)
+                if res is not None:
+                    return res
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in const_names:
+                return True  # mesh_lib.DATA_AXIS
+            # Configured forwarding: flax modules carry the axis as a
+            # field (self.axis_name), threaded from the step builder.
+            return expr.attr in _FORWARD_PARAM_NAMES
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return all(self._resolves(e, values, const_names, fn_stack)
+                       for e in expr.elts)
+        return False
+
+    def _name_binding(self, fn, name, values, const_names):
+        """True/False when ``name`` is bindable inside ``fn``: a
+        parameter (default decides; no default = forwarding param —
+        allowed only for axis/axis_name spellings), or an assignment
+        from a resolvable expression.  None when ``fn`` says nothing."""
+        args = fn.args
+        params = args.args + args.kwonlyargs
+        defaults = ([None] * (len(args.args) - len(args.defaults))
+                    + list(args.defaults) + list(args.kw_defaults))
+        for param, default in zip(params, defaults):
+            if param.arg != name:
+                continue
+            if default is not None:
+                return self._resolves(default, values, const_names, [fn])
+            return name in _FORWARD_PARAM_NAMES
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return self._resolves(node.value, values,
+                                              const_names, [fn])
+        return None
+
+    # -- the walk ---------------------------------------------------------
+
+    def _check_module(self, tree, rel, abspath, values, const_names,
+                      problems):
+        in_mesh = os.path.abspath(abspath) == os.path.abspath(MESH_PATH)
+
+        def visit(node, fn_stack):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_stack = fn_stack + [node]
+            elif isinstance(node, ast.Call):
+                self._check_call(node, rel, in_mesh, values, const_names,
+                                 fn_stack, problems)
+            for child in ast.iter_child_nodes(node):
+                visit(child, fn_stack)
+
+        visit(tree, [])
+
+    def _check_call(self, node, rel, in_mesh, values, const_names,
+                    fn_stack, problems):
+        fn = node.func
+        called = (fn.attr if isinstance(fn, ast.Attribute)
+                  else fn.id if isinstance(fn, ast.Name) else "")
+        if called not in COLLECTIVES:
+            return
+        axis_expr = None
+        pos = _AXIS_ARG_POS[called]
+        if len(node.args) > pos \
+                and not any(isinstance(a, ast.Starred)
+                            for a in node.args[:pos + 1]):
+            axis_expr = node.args[pos]
+        else:
+            for kw in node.keywords:
+                if kw.arg in _AXIS_KEYWORDS:
+                    axis_expr = kw.value
+                    break
+        if axis_expr is None:
+            problems.append(Finding(
+                check=self.id, path=rel, line=node.lineno,
+                message=f"{called}() with no statically visible axis "
+                        "argument — the collective's axis cannot be "
+                        "audited",
+                hint="pass the axis positionally or as axis_name=, "
+                     "naming a registered *_AXIS constant"))
+            return
+        if not self._resolves(axis_expr, values, const_names, fn_stack):
+            lit = (f"{axis_expr.value!r}"
+                   if isinstance(axis_expr, ast.Constant)
+                   else ast.dump(axis_expr)[:60])
+            problems.append(Finding(
+                check=self.id, path=rel, line=node.lineno,
+                message=(f"{called}() over unregistered/unresolvable "
+                         f"axis {lit} — collectives must name an axis "
+                         "registered in parallel/mesh.py (*_AXIS "
+                         "constants)"),
+                hint="use DATA_AXIS / mesh_lib.DATA_AXIS (or register "
+                     "the new axis constant in parallel/mesh.py)"))
+            return
+        # The one-spelling owner-gather rule: psum of a where-masked
+        # select is mesh_lib.owner_rows' job.
+        if called == "psum" and fn_stack \
+                and self._is_masked_operand(node, fn_stack[-1]) \
+                and not (in_mesh and fn_stack[-1].name == "owner_rows"):
+            problems.append(Finding(
+                check=self.id, path=rel, line=node.lineno,
+                message="masked-psum owner-gather idiom spelled by hand "
+                        "(psum of a jnp.where-masked operand) — the one "
+                        "spelling lives in parallel/mesh.owner_rows",
+                hint="call mesh_lib.owner_rows(arr, idxs, axis) instead "
+                     "of re-deriving the masked psum"))
+
+    @staticmethod
+    def _is_masked_operand(call, fn) -> bool:
+        """True when the psum's operand is a local name assigned from a
+        ``where(...)`` call inside ``fn``."""
+        if not call.args or not isinstance(call.args[0], ast.Name):
+            return False
+        target = call.args[0].id
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == target
+                    for t in node.targets):
+                v = node.value
+                if isinstance(v, ast.Call):
+                    f = v.func
+                    name = (f.attr if isinstance(f, ast.Attribute)
+                            else f.id if isinstance(f, ast.Name) else "")
+                    if name == "where":
+                        return True
+        return False

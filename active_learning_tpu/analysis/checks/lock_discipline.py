@@ -1,0 +1,196 @@
+"""lock-discipline: a static race detector for the thread zoo.
+
+PRs 7-9 each needed review rounds to catch the same bug class: a field
+that one thread mutates under a lock being read bare by another (the
+resident cache's iteration paths, the pipeline's plan/bus state, the
+telemetry sinks written by the watchdog thread).  This checker turns
+the discipline into a module-local declaration the engine can PROVE:
+
+    _GUARDED_BY = {"_plan": "_cv", "stats": "_cv"}      # field -> lock
+    _LOCKED_HELPERS = ("hit",)                          # called under it
+
+Every lexical read or write of a guarded field — ``self.<field>`` /
+``obj.<field>`` attribute access, ``d["<field>"]`` subscripts, and
+``.get("<field>")``/``.setdefault("<field>")``/``.pop("<field>")`` dict
+calls (the spelling the resident cache uses) — must sit inside a
+``with self.<lock>:`` / ``with <LOCK>:`` block, or inside a function
+declared as a locked helper (named in ``_LOCKED_HELPERS`` or suffixed
+``_locked`` — the existing ``_next_job_locked`` convention).
+``__init__``/``__new__`` are exempt: an object under construction is
+not yet shared.
+
+The check is LEXICAL: a nested function defined inside a ``with`` block
+counts as guarded even if something later calls it bare (don't do
+that), and aliases hoisted out of a locked region are not tracked.
+That is the same trade every annotation-based race checker
+(GUARDED_BY in Clang's thread-safety analysis, the original Java
+``@GuardedBy``) makes — cheap, zero-false-negative on the direct-access
+pattern this codebase uses, and the registry documents intent even
+where the proof is partial.
+
+Suppression: ``# al-lint: lock-ok <reason>`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..engine import Checker, Context
+from ..findings import Finding
+
+_DICT_KEY_CALLS = {"get", "setdefault", "pop"}
+_EXEMPT_FNS = {"__init__", "__new__"}
+
+
+def _module_registry(tree: ast.Module, rel: str, problems: List[Finding]
+                     ) -> Tuple[Optional[Dict[str, str]], set]:
+    """Parse ``_GUARDED_BY`` (dict of str -> str literals) and
+    ``_LOCKED_HELPERS`` (tuple of str literals) from the module body.
+    Returns (guarded map or None, helper names)."""
+    guarded = None
+    helpers: set = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if "_GUARDED_BY" in names:
+            if not isinstance(node.value, ast.Dict):
+                problems.append(Finding(
+                    check="lock-discipline", path=rel, line=node.lineno,
+                    message="_GUARDED_BY must be a literal dict of "
+                            "{'field': 'lock'} string pairs — the "
+                            "registry must be statically checkable"))
+                continue
+            guarded = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    guarded[k.value] = v.value
+                else:
+                    problems.append(Finding(
+                        check="lock-discipline", path=rel,
+                        line=getattr(k, "lineno", node.lineno),
+                        message="_GUARDED_BY holds a non-literal entry — "
+                                "fields and locks are declared as string "
+                                "literals"))
+        elif "_LOCKED_HELPERS" in names \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    helpers.add(elt.value)
+    return guarded, helpers
+
+
+def _lock_names_of_with(node) -> set:
+    """The terminal names of a With statement's context managers:
+    ``with self._cv:`` -> {_cv}, ``with _CACHE_LOCK:`` -> {_CACHE_LOCK}."""
+    names = set()
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Attribute):
+            names.add(expr.attr)
+        elif isinstance(expr, ast.Name):
+            names.add(expr.id)
+    return names
+
+
+def _lock_defined(tree: ast.Module, lock: str) -> bool:
+    """The declared lock must exist somewhere: a module-level assignment
+    (``_CACHE_LOCK = threading.RLock()``) or an instance attribute
+    assignment (``self._cv = threading.Condition()``)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == lock:
+                    return True
+                if isinstance(t, ast.Attribute) and t.attr == lock:
+                    return True
+    return False
+
+
+class LockDisciplineChecker(Checker):
+    id = "lock-discipline"
+    title = ("every access to a _GUARDED_BY field happens under its "
+             "declared lock")
+    suppress_token = "lock-ok"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        problems: List[Finding] = []
+        for path in ctx.files:
+            tree, err = ctx.tree(path)
+            if err is not None:
+                continue  # parse failures are the legacy checks' finding
+            rel = ctx.rel(path)
+            guarded, helpers = _module_registry(tree, rel, problems)
+            if not guarded:
+                continue
+            locks = set(guarded.values())
+            for lock in sorted(locks):
+                if not _lock_defined(tree, lock):
+                    problems.append(Finding(
+                        check=self.id, path=rel, line=0,
+                        message=f"_GUARDED_BY names lock {lock!r} but "
+                                "nothing in the module defines it — the "
+                                "registry drifted from the code",
+                        hint="declare the lock (module-level or in "
+                             "__init__) or fix the registry entry"))
+            self._scan(tree, rel, guarded, helpers, problems)
+        return problems
+
+    # -- the lexical walk -------------------------------------------------
+
+    def _scan(self, tree, rel, guarded, helpers, problems):
+        checker = self
+
+        def fn_exempt(name: str) -> bool:
+            return (name in _EXEMPT_FNS or name in helpers
+                    or name.endswith("_locked"))
+
+        def visit(node, held: frozenset, exempt: bool):
+            """held: lock names lexically held here; exempt: inside a
+            constructor or declared locked helper."""
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                exempt = exempt or fn_exempt(node.name)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                held = held | frozenset(_lock_names_of_with(node))
+            elif not exempt:
+                checker._check_access(node, rel, guarded, held, problems)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, exempt)
+
+        visit(tree, frozenset(), False)
+
+    def _check_access(self, node, rel, guarded, held, problems):
+        field = None
+        if isinstance(node, ast.Attribute) and node.attr in guarded:
+            field = node.attr
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str) \
+                and node.slice.value in guarded:
+            field = node.slice.value
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _DICT_KEY_CALLS and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str) \
+                and node.args[0].value in guarded:
+            field = node.args[0].value
+        if field is None:
+            return
+        lock = guarded[field]
+        if lock in held:
+            return
+        problems.append(Finding(
+            check=self.id, path=rel, line=node.lineno,
+            message=(f"{field!r} is guarded by {lock!r} "
+                     f"(_GUARDED_BY) but accessed outside any "
+                     f"'with {lock}:' block — a cross-thread race"),
+            hint=f"wrap the access in 'with ...{lock}:', move it into a "
+                 "*_locked/_LOCKED_HELPERS helper, or annotate "
+                 "'# al-lint: lock-ok <reason>'"))

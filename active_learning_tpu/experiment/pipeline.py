@@ -79,6 +79,17 @@ PIPELINE_COORDINATOR_FNS = ("_worker", "_worker_loop", "_score_slice",
                             "_score_chunk", "publish_best", "finalize",
                             "consume")
 
+# Lock discipline, statically enforced (scripts/al_lint.py
+# lock-discipline): the plan/bus state below is shared between the
+# driver thread (arm/consume/disarm), the trainer thread (publish_best/
+# finalize), and the scorer thread (_worker_loop) — every access goes
+# through the one condition's lock.  _next_job_locked is the declared
+# under-the-lock helper (the *_locked suffix convention).
+_GUARDED_BY = {"_plan": "_cv", "_done": "_cv", "_src": "_cv",
+               "_final_tag": "_cv", "_consumed": "_cv",
+               "_in_flight": "_cv", "_busy_s": "_cv", "_stop": "_cv",
+               "stats": "_cv"}
+
 
 def resolve_round_pipeline(spec: Optional[str], mesh) -> str:
     """The --round_pipeline auto rule: "speculative" on any
@@ -350,9 +361,24 @@ class RoundPipeline:
                 self._start_prefetch()
                 return None
             final = self._final_tag
-            done = {i: (out, dt)
-                    for i, (tag, out, dt) in self._done.items()
-                    if final is not None and tag == final}
+            done = {}
+            stale = 0
+            for i, (tag, out, dt) in self._done.items():
+                if final is not None and tag == final:
+                    done[i] = (out, dt)
+                else:
+                    stale += 1
+            # Chunks scored under a superseded tag are invalidated no
+            # matter WHO notices first: if the scorer thread never woke
+            # between the late publish and this consume (it had already
+            # finished every chunk under the early tag), the dropped
+            # entries would otherwise vanish uncounted —
+            # chunks_invalidated read 0 after a forced late-best
+            # invalidation, a scheduling-dependent accounting hole
+            # (_next_job_locked's cleanup counts the same supersession
+            # when the worker DOES wake first; both paths remove what
+            # they count, so they can never double-count).
+            self.stats["chunks_invalidated"] += stale
             slices = list(plan["slices"])
             self._done = {}
         outs: List[Dict[str, np.ndarray]] = []
@@ -374,8 +400,13 @@ class RoundPipeline:
                 score_s += time.perf_counter() - t0
                 inline += 1
         result = scoring.splice_chunks(outs)
-        self.stats["chunks_hit"] += hits
-        self.stats["chunks_inline"] += inline
+        # Under the lock like every other stats mutation: the worker's
+        # death harness can still increment chunks_failed concurrently
+        # with this hand-over (found by the lock-discipline checker —
+        # a bare += here is a read-modify-write race with that thread).
+        with self._cv:
+            self.stats["chunks_hit"] += hits
+            self.stats["chunks_inline"] += inline
         self.last_consume = {"chunks": len(slices), "hits": hits,
                              "inline": inline,
                              "hit_frac": round(hits / max(1, len(slices)),

@@ -157,6 +157,13 @@ class _SiteState:
 _ARMED: Optional[Dict[str, _SiteState]] = None
 _LOCK = threading.Lock()
 
+# Lock discipline, statically enforced (scripts/al_lint.py
+# lock-discipline): per-site hit/fire counters are mutated from every
+# thread a site fires on — counted only under _LOCK.  ``hit`` is the
+# declared under-the-lock helper (site() holds _LOCK around it).
+_GUARDED_BY = {"hits": "_LOCK", "fires": "_LOCK"}
+_LOCKED_HELPERS = ("hit",)
+
 
 def parse_spec(spec: str) -> Dict[str, Tuple[str, Any]]:
     """``"h2d_upload:raise@3,ckpt_write:torn@1"`` ->

@@ -76,6 +76,19 @@ _UPLOAD_RETRY = faults.RetryPolicy(site="h2d_upload",
 # pinned_bytes under the lock.
 _CACHE_LOCK = threading.RLock()
 
+# Lock discipline, statically enforced (scripts/al_lint.py
+# lock-discipline): the cache's three shared maps may only be touched
+# under _CACHE_LOCK — the speculative scorer, the trainer's validation,
+# and the LRU/demotion paths all race on them otherwise.
+_GUARDED_BY = {"images": "_CACHE_LOCK",
+               "steps": "_CACHE_LOCK",
+               "lru": "_CACHE_LOCK"}
+
+# Registered step-builder (al_lint recompile-hazard): the jitted
+# gather+step runners are built once per (step_fn, labels, layout) and
+# cached in the shared resident pool.
+_STEP_BUILDERS = ("get_runner",)
+
 # HBM held back from the auto-sized resident budget: training activations,
 # XLA workspace, and the model/optimizer trees all coexist with a pinned
 # pool.  4 GB covers the ResNet-50 224px train step at 256 rows/chip
@@ -233,7 +246,13 @@ def cached(cache: Optional[Dict], dataset: Any) -> bool:
     images = getattr(dataset, "images", None)
     if not isinstance(images, np.ndarray):
         return False
-    return (id(images), len(dataset)) in cache.get("images", {})
+    # Under the cache lock like every other reader: the speculative
+    # scorer resolves entries concurrently with the trainer's uploads,
+    # and this membership probe was the one access left bare (found by
+    # the lock-discipline checker; the GIL made it merely racy-looking
+    # on CPython, but the discipline is the contract).
+    with _CACHE_LOCK:
+        return (id(images), len(dataset)) in cache.get("images", {})
 
 
 def pool_arrays(cache: Dict, dataset: Any, mesh,

@@ -36,12 +36,22 @@ back the same way.
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..pool import bucket_size
+
+# Lock discipline, statically enforced (scripts/al_lint.py
+# lock-discipline): the admitted-row counter is the 429 backpressure
+# bound.  Completion callbacks are MARSHALLED to the event loop
+# (call_soon_threadsafe), but the bound is too load-bearing to rest on
+# that convention alone — every touch of the counter takes the
+# admission lock (uncontended in the steady state: nanoseconds), so a
+# future resolved off-loop can never silently breach queue_depth.
+_GUARDED_BY = {"_pending_rows": "_admission_lock"}
 
 # Default floor for the serve bucket ladder: far below the pool-scan
 # floor (256) because a serving microbatch's lower bound is ONE row —
@@ -125,6 +135,7 @@ class MicroBatcher:
         self._inbox: asyncio.Queue = asyncio.Queue()
         self._carry: Optional[_Entry] = None
         self._pending_rows = 0  # admitted, not yet completed
+        self._admission_lock = threading.Lock()
         self._closing = False
         self._task: Optional[asyncio.Task] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -138,7 +149,8 @@ class MicroBatcher:
 
     @property
     def pending_rows(self) -> int:
-        return self._pending_rows
+        with self._admission_lock:
+            return self._pending_rows
 
     # -- admission (event-loop thread) -----------------------------------
 
@@ -153,9 +165,18 @@ class MicroBatcher:
         n = int(images.shape[0])
         if n == 0:
             raise ValueError("empty request")
-        if self._pending_rows + n > self.queue_depth:
+        with self._admission_lock:
+            pending = self._pending_rows
+            if pending + n > self.queue_depth:
+                admitted = False
+            else:
+                # Check-and-increment atomically: two submits racing the
+                # bound must not both pass the check and overshoot it.
+                self._pending_rows = pending + n
+                admitted = True
+        if not admitted:
             raise QueueFullError(
-                f"{self._pending_rows} rows pending, request of {n} "
+                f"{pending} rows pending, request of {n} "
                 f"exceeds queue_depth={self.queue_depth}")
         loop = asyncio.get_running_loop()
         entries = []
@@ -171,7 +192,6 @@ class MicroBatcher:
             e.future.add_done_callback(
                 lambda _f, rows=e.n: self._release(rows))
             entries.append(e)
-        self._pending_rows += n
         for e in entries:
             self._inbox.put_nowait(e)
         # gather (not sequential awaits): a failing chunk must not
@@ -267,7 +287,8 @@ class MicroBatcher:
     def _release(self, rows: int) -> None:
         """Per-chunk admission release (future done callback, loop
         thread)."""
-        self._pending_rows -= rows
+        with self._admission_lock:
+            self._pending_rows -= rows
 
     async def drain(self, poll_s: float = 0.01,
                     timeout_s: Optional[float] = None) -> None:
@@ -279,9 +300,9 @@ class MicroBatcher:
         if self._task is not None:
             await self._task
         t0 = self._clock()
-        while self._pending_rows > 0:
+        while self.pending_rows > 0:
             if timeout_s is not None and self._clock() - t0 > timeout_s:
                 raise asyncio.TimeoutError(
-                    f"drain: {self._pending_rows} rows still pending "
+                    f"drain: {self.pending_rows} rows still pending "
                     f"after {timeout_s}s")
             await asyncio.sleep(poll_s)

@@ -52,6 +52,11 @@ import numpy as np
 from ..parallel import mesh as mesh_lib
 from .base import Strategy, register_strategy
 
+# Registered step-builders (scripts/al_lint.py recompile-hazard): the
+# module-level jitted picks compile once per pool shape by construction;
+# any NEW jax.jit here must be named below or the lint fails.
+_STEP_BUILDERS = ("_balancing_pick", "_mark_taken", "_set_center_row")
+
 
 @jax.jit
 def _balancing_pick(emb, eligible, centers, maj_mask, rarest, rare_empty):

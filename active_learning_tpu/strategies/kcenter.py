@@ -96,6 +96,15 @@ DEFAULT_BATCH_Q = 8
 # pool size; padded rows are zero factors masked out via ``selectable``.
 POOL_BUCKET_FLOOR = 256
 
+# Registered step-builders (scripts/al_lint.py recompile-hazard): the
+# module-level jitted scans compile once per pool bucket; the sharded
+# backend's jits live inside _build_sharded_fns (one set per
+# (mesh, n_factors), cached in _SHARDED_JITS).  A jax.jit anywhere else
+# in this module fails the lint.
+_STEP_BUILDERS = ("_min_dist_chunk", "_kcenter_scan",
+                  "_kcenter_scan_batched", "_minimax_row",
+                  "_build_sharded_fns")
+
 
 def self_sq_norms(factors: Factors) -> jnp.ndarray:
     """||g_i||^2 = prod_F (F_i . F_i)  — [N]."""

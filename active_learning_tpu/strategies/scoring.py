@@ -33,6 +33,13 @@ from ..parallel import mesh as mesh_lib
 from ..data.pipeline import (batch_index_lists, iterate_batches,
                              padded_batch_layout)
 
+# Registered step-builders (scripts/al_lint.py recompile-hazard): every
+# jax.jit in this module sits inside one of these factories (one step
+# per (model, view), reused across rounds) or is the module-level
+# head_pair_norms; a stray jit outside them fails the lint.
+_STEP_BUILDERS = ("make_prob_stats_step", "make_embed_step",
+                  "make_badge_step", "make_mase_step", "head_pair_norms")
+
 
 def batched_min_dist_update(factors, sqn: jnp.ndarray,
                             min_dist: jnp.ndarray,

@@ -55,6 +55,16 @@ from ..train.optim import make_lr_schedule
 from . import scoring
 from .base import Strategy, register_strategy
 
+# Registered step-builders (scripts/al_lint.py recompile-hazard): both
+# jitted steps are built once per sampler and reused across epochs.
+_STEP_BUILDERS = ("_build_vaal_step", "_build_score_step")
+
+# Donating callables stored on attributes (al_lint donation-safety):
+# the co-training step donates the VAALState at position 0 — every call
+# site must rebind self.vaal_state from the result in the same
+# statement or the lint flags a use-after-donate.
+_DONATES = {"_vaal_step": (0,)}
+
 
 class VAALState(struct.PyTreeNode):
     vae_params: dict

@@ -31,6 +31,13 @@ from . import heartbeat as hb_lib
 from . import prom as prom_lib
 from . import spans as spans_lib
 
+# Lock discipline, statically enforced (scripts/al_lint.py
+# lock-discipline): gauges and the jit registry are written by the
+# driver thread and read by the watchdog/status paths — always under
+# the run's _lock.
+_GUARDED_BY = {"_gauges": "_lock", "_jits": "_lock",
+               "_jit_total_last": "_lock"}
+
 
 def percentile(values: List[float], q: float) -> Optional[float]:
     """Nearest-rank percentile (same convention as serve/metrics.py and

@@ -36,6 +36,14 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+# Lock discipline, statically enforced (scripts/al_lint.py
+# lock-discipline): the event buffer, its drop counter, and the
+# thread-name map are appended from every span-opening thread (main,
+# spec-scorer, feed-prefetch, watchdog, serve executor) — always under
+# the tracer's _lock.
+_GUARDED_BY = {"events": "_lock", "dropped": "_lock",
+               "_thread_names": "_lock"}
+
 
 class Span:
     """One completed (or in-flight) host span."""

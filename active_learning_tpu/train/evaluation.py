@@ -42,6 +42,11 @@ def batch_metric_counts(logits: jnp.ndarray, labels: jnp.ndarray,
     }
 
 
+# Registered step-builder (scripts/al_lint.py recompile-hazard): the
+# eval step is built once per (model, view) and cached by the trainer.
+_STEP_BUILDERS = ("make_eval_step",)
+
+
 def make_eval_step(model, view: ViewSpec, num_classes: int):
     """Jitted: uint8 batch -> metric counts.  The batch arrives sharded over
     the mesh's data axis; XLA reduces the counts across devices."""

@@ -60,6 +60,28 @@ _CKPT_RETRY = faults.RetryPolicy(site="ckpt_write",
                                  classify=faults.classify_exception,
                                  max_attempts=3)
 
+# Registered step-builders (scripts/al_lint.py recompile-hazard): every
+# jax.jit in this module lives inside one of these — the zero-recompile
+# warm-round invariant (tests/test_compile_reuse.py) is only auditable
+# when the set of compile sites is enumerable.
+_STEP_BUILDERS = ("_build_train_step", "_build_train_step_int8",
+                  "_build_chained_train_step",
+                  "_build_resident_batch_step", "_build_epoch_scan",
+                  "reinit_optimizer")
+
+# Donating callables stored on attributes (al_lint donation-safety):
+# attribute name -> donate_argnums of the underlying jitted step.  Every
+# non-traced call site must rebind the donated argument from the result
+# in the same statement (``state, ... = self._train_step(state, ...)``)
+# or the lint flags a use-after-donate of the deleted buffer — the bug
+# class reinit_optimizer's out_shardings/zeroing work dodged by hand in
+# PR 9.
+_DONATES = {"_train_step": (0,),
+            "_chained_train_step": (0, 2),
+            "_resident_batch_step": (0, 5),
+            "_epoch_scan": (0,),
+            "_reinit_opt": (0,)}
+
 
 class TrainState(struct.PyTreeNode):
     params: Any
@@ -1242,7 +1264,7 @@ class Trainer:
                         small = mesh_lib.replicate(
                             (ids.astype(np.int32), mask), self.mesh)
                         state, key, loss, gnorm = \
-                            self._resident_batch_step(
+                            self._resident_batch_step(  # al-lint: donated-ok positions 3-4 are the *small (ids, mask) splat; the donated key at 5 is rebound by this statement's own targets
                                 state, dr_images, dr_labels, *small, key,
                                 lr, class_weights, view=train_set.view,
                                 sharded=dr_sharded)

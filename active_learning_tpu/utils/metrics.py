@@ -26,6 +26,16 @@ import time
 import uuid
 from typing import Any, Dict, Iterable, Optional
 
+# Lock discipline, statically enforced (scripts/al_lint.py
+# lock-discipline): the telemetry watchdog emits through the SAME sink
+# objects as the main loop, from its own thread — every touch of a
+# sink's writer handle (and the TensorBoard per-name auto-step map)
+# happens under that sink's _lock.  _next_step is the declared
+# under-the-lock helper (log_metrics holds the lock around it).
+_GUARDED_BY = {"_fh": "_lock", "_writer": "_lock",
+               "_auto_steps": "_lock"}
+_LOCKED_HELPERS = ("_next_step",)
+
 
 class MetricsSink:
     """Abstract sink.  ``step`` mirrors comet's step argument (round, epoch,
@@ -102,7 +112,11 @@ class JsonlSink(MetricsSink):
         self._emit({"kind": "asset", "name": name, "path": path})
 
     def close(self):
-        self._fh.close()
+        # Under the lock: a watchdog-thread emit racing an unlocked
+        # close() would write to (or flush) a closed file and kill the
+        # watchdog thread with it (found by the lock-discipline checker).
+        with self._lock:
+            self._fh.close()
 
 
 def _to_float(v: Any) -> float:
@@ -162,7 +176,9 @@ class CsvSink(MetricsSink):
             fh.write(data)
 
     def close(self):
-        self._fh.close()
+        # Same close-vs-emit race as JsonlSink.close.
+        with self._lock:
+            self._fh.close()
 
 
 class TensorBoardSink(MetricsSink):
@@ -190,7 +206,11 @@ class TensorBoardSink(MetricsSink):
 
     def log_parameters(self, params):
         text = "\n".join(f"    {k}: {v}" for k, v in sorted(params.items()))
-        self._writer.add_text("parameters", text)
+        # SummaryWriter is not documented thread-safe: add_text must
+        # hold the same lock as the watchdog-thread add_scalar emits
+        # (found by the lock-discipline checker).
+        with self._lock:
+            self._writer.add_text("parameters", text)
 
     def _next_step(self, name: str) -> int:
         # PER-NAME auto-step: a single shared counter incremented once
@@ -221,7 +241,9 @@ class TensorBoardSink(MetricsSink):
             fh.write(data)
 
     def close(self):
-        self._writer.close()
+        # Same close-vs-emit race as JsonlSink.close.
+        with self._lock:
+            self._writer.close()
 
 
 class MultiSink(MetricsSink):

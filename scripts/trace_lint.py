@@ -1,762 +1,154 @@
 #!/usr/bin/env python
-"""trace_lint: spans and phase metrics must be ONE measurement.
+"""trace_lint: compatibility shim over the analysis engine.
 
-The telemetry design (DESIGN.md §7) hangs on a single invariant: every
-phase timer routes through the span tracer, so the Chrome trace and the
-``rd_{name}`` metrics can never silently fork — a phase that appears in
-metrics.jsonl but not in trace.json (or with a different duration)
-would make the trace unusable as evidence.  This lint enforces the
-routing statically, invoked from tier-1 (tests/test_telemetry.py):
+The 10 checks this script used to implement as a 773-line monolith now
+live in ``active_learning_tpu/analysis/checks/legacy.py``, ported
+verbatim onto the shared-parse engine (DESIGN.md §12) — same verdicts,
+same messages, one ``ast.parse`` per file instead of one per check.
+This shim keeps the historical import surface alive so every existing
+entry point (tests/test_telemetry.py's fragment tests, the tier-1
+subprocess run, monkeypatched ``_py_files``) works unchanged:
 
-  1. ``utils/tracing.phase_timer`` itself must open a tracer span and
-     derive its reported seconds FROM that span (not a second clock).
-  2. Nobody else may define a ``phase_timer`` (a fork would bypass the
-     tracer while keeping the metric name).
-  3. Every module calling ``phase_timer(`` must import it from
-     ``utils.tracing`` — no copies, no local re-implementations.
-  4. ``jax.profiler.TraceAnnotation`` stays behind ``tracing.annotate``
-     (one device-naming convention; the whitelist is the device-truth
-     layer, telemetry/profiler.py, which tracing.annotate delegates to).
+  1  phase_timer derives its seconds from ONE tracer span
+  2  nobody else defines a phase_timer
+  3  call sites import phase_timer from utils.tracing
+  4  jax.profiler.TraceAnnotation stays behind tracing.annotate
+  5  the resident train feed never materializes images on host
+  6  the row-sharded selection backend never un-shards the pool
+  7  the speculative-scoring coordinator never syncs the train stream
+  8  the fault-site registry is closed, wired, and classify='d
+  9  custom VJPs are registered in ops/backward.py and parity-tested
+  10 jax.profiler stays confined to telemetry/profiler.py
 
-It also enforces the trainer's ZERO-HOST-COPY feed invariant (the
-resident-gather train feed, DESIGN.md §2a):
+The four NEW checkers (lock-discipline, donation-safety,
+recompile-hazard, collective-axis) are deliberately NOT run here — this
+shim's contract is "identical verdicts to the legacy monolith";
+``scripts/al_lint.py`` is the full 14-check CLI.
 
-  5. ``train/trainer.py`` must define every function in
-     ``RESIDENT_FEED_FNS``, and none of them may materialize image data
-     on the host — no ``np.*`` usage, no ``.gather(`` call, no
-     ``.asarray``/``.concatenate`` — so "train batches never touch the
-     host" is a statically-checked property, not just a benched one.
-
-... and the sharded pool's SCALE-OUT invariant (row-sharded selection,
-DESIGN.md §2b):
-
-  6. ``strategies/kcenter.py`` must define every function in its
-     ``SHARDED_SELECTION_FNS``, and none of them may defeat the
-     sharding: no full-pool host materialization (``np.*`` references,
-     ``jax.device_get``, ``.asarray``) and no replication of a
-     row-sharded array (``replicate(`` / ``replicated_sharding(``
-     calls) — a 10.5 GB factor matrix pulled whole onto one host or
-     chip is exactly the ceiling the sharded backend exists to break.
-
-... and the pipelined round's NEVER-SYNC-THE-TRAIN-STREAM invariant
-(speculative scoring, DESIGN.md §8):
-
-  7. ``experiment/pipeline.py`` must define every function in
-     ``PIPELINE_COORDINATOR_FNS``, and none of them may call
-     ``block_until_ready`` or ``device_get`` — the speculative scorer
-     overlaps the fit's patience tail, and a coordinator-level device
-     sync would serialize the very streams the module exists to
-     overlap.  (The scorer may wait on its OWN chunk outputs inside
-     collect_pool's host fetch — that blocks only its thread — and the
-     DispatchGate's CPU-only execution drain lives in parallel/mesh.py,
-     deliberately outside the lint's reach: it is the backend
-     workaround, not the coordinator.)
-
-... and the failure model's CLOSED-REGISTRY invariant (fault injection,
-DESIGN.md §10):
-
-  8. Every ``faults.site()`` call site names a string-literal site that
-     is registered in ``faults/registry.py``'s ``SITES`` tuple, each
-     registered name appears there exactly once AND is wired at ≥1 call
-     site (a typo'd or orphaned site would make chaos coverage silently
-     vacuous), and every ``RetryPolicy(...)`` construction passes an
-     explicit ``classify=`` keyword — the "no bare ``except Exception:
-     retry``" rule: what a call site considers transient is always
-     written at the call site.
-
-... and the gradient path's PROVEN-BACKWARD invariant (custom VJPs +
-the fused optimizer, DESIGN.md §4):
-
-  9. Every ``jax.custom_vjp`` in the package lives in
-     ``ops/backward.py`` (a hand-written backward anywhere else would
-     dodge the registry), its public name appears in that module's
-     ``TRAIN_PATH_VJPS`` tuple, and ``tests/test_backward.py``'s
-     ``PARITY_TESTED_VJPS`` tuple matches it exactly — a closed
-     registry like check 8: a custom backward without a registered
-     gradient-parity test can never land.  The fused optimizer-update
-     functions (``train/optim.py``'s ``FUSED_UPDATE_FNS``) run inside
-     the donated train step and are forbidden host materialization
-     (``np.*`` references, ``.asarray``/``device_get``/
-     ``block_until_ready`` calls).
-
-... and the device-truth layer's ONE-GATE invariant (bounded profiler
-capture windows, DESIGN.md §11):
-
-  10. ``jax.profiler`` may only be imported or invoked inside
-      ``telemetry/profiler.py`` — no ``import jax.profiler`` /
-      ``from jax import profiler``, no ``jax.profiler`` attribute
-      access, and no ``start_trace``/``stop_trace`` call (under ANY
-      alias) anywhere else.  Every capture window goes through the
-      gated API (``capture_window``/``start_capture``/
-      ``finish_capture``), which is what makes "one capture at a time,
-      always stopped on failure, always merged and classified" a
-      property of the system instead of a convention — and the gate
-      module itself must define those entry points and actually touch
-      jax.profiler (a renamed-away gate would make the check vacuous).
-      A closed registry like checks 8 and 9.
-
-Stdlib only; exits 0 clean / 1 with findings on stderr.
+Stdlib + the (jax-free) analysis package only; exits 0 clean / 1 with
+findings on stderr.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from active_learning_tpu.analysis.checks import legacy as _legacy  # noqa: E402
+from active_learning_tpu.analysis.engine import AstCache  # noqa: E402
+
 PKG = os.path.join(REPO, "active_learning_tpu")
-TRACING = os.path.join(PKG, "utils", "tracing.py")
-PROFILER = os.path.join(PKG, "telemetry", "profiler.py")
 
-# The one module allowed to touch jax.profiler (TraceAnnotation included):
-# the device-truth layer.  tracing.annotate delegates here.
-ANNOTATION_WHITELIST = {PROFILER}
-
-# Capture-window entry points: calling either outside the gate module —
-# under any alias — dodges the one-capture-at-a-time/always-stopped/
-# always-merged contract.
-_CAPTURE_CALLS = {"start_trace", "stop_trace"}
-# The gated API the gate module must define (a renamed-away gate would
-# make check 10 vacuous).
-_PROFILER_GATE_FNS = ("start_capture", "finish_capture", "capture_window",
-                      "trace_annotation")
-
-TRAINER = os.path.join(PKG, "train", "trainer.py")
-# The trainer functions that ARE the resident-gather feed path: each must
-# exist (renaming one away would silently drop the enforcement) and must
-# never materialize image arrays on the host.
-RESIDENT_FEED_FNS = ("_resident_feed_arrays", "_build_resident_batch_step")
-# Host-materialization markers forbidden inside those functions.
-_HOST_COPY_CALLS = {"gather", "asarray", "concatenate", "ascontiguousarray",
-                    "stack", "copy"}
-
-KCENTER = os.path.join(PKG, "strategies", "kcenter.py")
-# The kcenter functions that ARE the row-sharded selection backend (the
-# module's own SHARDED_SELECTION_FNS names the device builder; this
-# mirror exists so the lint works without importing jax).  Each must
-# exist, and none may defeat the sharding.  Two rule sets:
-#   device tier (_build_sharded_fns — everything traced onto the mesh):
-#     no np.* at all, no jax.device_get/.asarray host fetches, no
-#     replicate/replicated_sharding calls;
-#   orchestrator tier (_kcenter_greedy_sharded — owns the HOST copy of
-#     the factors by design, so np index math is fine): no
-#     jax.device_get and no replicate/replicated_sharding — the device
-#     pool must never round-trip to host or be replicated per chip.
-# NOTE: lax.all_gather of the O(N) weight VECTOR is allowed (the
-# randomized D^2 draw needs the global weights); what is forbidden is
-# pulling the [N, D] factor matrix whole.
-SHARDED_DEVICE_FNS = ("_build_sharded_fns",)
-SHARDED_ORCHESTRATOR_FNS = ("_kcenter_greedy_sharded",)
-_SHARDED_HOST_CALLS = {"device_get", "asarray"}
-_SHARDED_REPLICATE_CALLS = {"replicate", "replicated_sharding"}
-
-PIPELINE = os.path.join(PKG, "experiment", "pipeline.py")
-# Mirror of experiment/pipeline.PIPELINE_COORDINATOR_FNS (kept in both
-# places so the lint works without importing jax): the coordinator tier
-# of the speculative scorer.  Each must exist; none may device-sync.
-PIPELINE_COORDINATOR_FNS = ("_worker", "_worker_loop", "_score_slice",
-                            "_score_chunk", "publish_best", "finalize",
-                            "consume")
-_PIPELINE_SYNC_CALLS = {"block_until_ready", "device_get"}
-
-FAULTS_REGISTRY = os.path.join(PKG, "faults", "registry.py")
-
-OPS_BACKWARD = os.path.join(PKG, "ops", "backward.py")
-OPTIM = os.path.join(PKG, "train", "optim.py")
-BACKWARD_TESTS = os.path.join(REPO, "tests", "test_backward.py")
-# Host-materialization markers forbidden inside the fused optimizer
-# update functions (they trace inside the donated train step).
-_FUSED_HOST_CALLS = {"asarray", "device_get", "block_until_ready",
-                     "gather"}
+# Historical constants, re-exported for callers that introspect them
+# (tests assert the FN tuples stay in lockstep with the modules).
+TRACING = _legacy.TRACING
+PROFILER = _legacy.PROFILER
+ANNOTATION_WHITELIST = _legacy.ANNOTATION_WHITELIST
+TRAINER = _legacy.TRAINER
+RESIDENT_FEED_FNS = _legacy.RESIDENT_FEED_FNS
+KCENTER = _legacy.KCENTER
+SHARDED_DEVICE_FNS = _legacy.SHARDED_DEVICE_FNS
+SHARDED_ORCHESTRATOR_FNS = _legacy.SHARDED_ORCHESTRATOR_FNS
+PIPELINE = _legacy.PIPELINE
+PIPELINE_COORDINATOR_FNS = _legacy.PIPELINE_COORDINATOR_FNS
+FAULTS_REGISTRY = _legacy.FAULTS_REGISTRY
+OPS_BACKWARD = _legacy.OPS_BACKWARD
+OPTIM = _legacy.OPTIM
+BACKWARD_TESTS = _legacy.BACKWARD_TESTS
 
 
 def _py_files():
-    for root, dirs, files in os.walk(PKG):
-        dirs[:] = [d for d in dirs if d != "__pycache__"]
-        for name in files:
-            if name.endswith(".py"):
-                yield os.path.join(root, name)
-    yield os.path.join(REPO, "bench.py")
-    scripts = os.path.join(REPO, "scripts")
-    if os.path.isdir(scripts):
-        for name in os.listdir(scripts):
-            if name.endswith(".py") and name != "trace_lint.py":
-                yield os.path.join(scripts, name)
+    """The package walk (monkeypatched by tests to point the whole lint
+    at fixture fragments — every package-wide check below resolves its
+    file set through THIS module-level function)."""
+    from active_learning_tpu.analysis.engine import default_files
+    return default_files(REPO)
 
 
-def _imports_phase_timer_from_tracing(tree: ast.AST) -> bool:
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module:
-            if node.module.endswith("tracing") and any(
-                    a.name == "phase_timer" for a in node.names):
-                return True
-    return False
+def _render(findings) -> list:
+    return [f.render() for f in findings]
 
 
 def check() -> list:
+    """All 10 legacy checks over the tree, one shared parse per file —
+    identical verdicts to the monolithic implementation."""
+    cache = AstCache()
+    files = list(_py_files())
     problems = []
-
-    # 1. The shim itself routes through the tracer.
-    with open(TRACING) as fh:
-        tracing_src = fh.read()
-    timer_body = tracing_src.split("def phase_timer", 1)
-    if len(timer_body) != 2:
-        problems.append(f"{TRACING}: phase_timer not found")
-        timer_src = ""
-    else:
-        # Up to the next top-level def.
-        timer_src = re.split(r"\n@|\ndef ", timer_body[1], maxsplit=1)[0]
-    if ".span(" not in timer_src:
-        problems.append(
-            f"{TRACING}: phase_timer does not open a tracer span — "
-            "phase metrics would fork from the trace")
-    if "duration_s" not in timer_src:
-        problems.append(
-            f"{TRACING}: phase_timer does not take its seconds from the "
-            "span (two clocks = metric/trace drift)")
-
-    for path in _py_files():
-        rel = os.path.relpath(path, REPO)
-        with open(path) as fh:
-            src = fh.read()
-        try:
-            tree = ast.parse(src)
-        except SyntaxError as e:
-            problems.append(f"{rel}: unparseable ({e})")
-            continue
-
-        # 2. No competing phase_timer definitions.
-        if path != TRACING:
-            for node in ast.walk(tree):
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)) \
-                        and node.name == "phase_timer":
-                    problems.append(
-                        f"{rel}:{node.lineno}: defines its own "
-                        "phase_timer — route through utils.tracing")
-
-        # 3. Call sites import the shim.
-        calls = [n for n in ast.walk(tree)
-                 if isinstance(n, ast.Call)
-                 and isinstance(n.func, ast.Name)
-                 and n.func.id == "phase_timer"]
-        if calls and path != TRACING \
-                and not _imports_phase_timer_from_tracing(tree):
-            problems.append(
-                f"{rel}:{calls[0].lineno}: calls phase_timer without "
-                "importing it from utils.tracing")
-
-        # 4. Device annotations stay behind tracing.annotate (AST-level:
-        # docstring mentions are fine, attribute uses are not).
-        if path not in ANNOTATION_WHITELIST:
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Attribute) \
-                        and node.attr == "TraceAnnotation":
-                    problems.append(
-                        f"{rel}:{node.lineno}: uses jax.profiler."
-                        "TraceAnnotation directly — use utils.tracing."
-                        "annotate so device spans keep one naming "
-                        "convention")
-
-    # 5. The resident-gather train feed stays zero-host-copy.
-    problems.extend(check_resident_feed())
-
-    # 6. The sharded selection backend never un-shards the pool.
-    problems.extend(check_sharded_selection())
-
-    # 7. The speculative-scoring coordinator never syncs the train
-    # stream.
-    problems.extend(check_pipeline_coordinator())
-
-    # 8. The fault-injection registry is closed, fully wired, and every
-    # retry call site classifies.
-    problems.extend(check_fault_sites())
-
-    # 9. Every custom VJP is registered and parity-tested; the fused
-    # optimizer update never touches the host.
-    problems.extend(check_backward_registry())
-
-    # 10. jax.profiler stays confined to the device-truth layer and
-    # every capture window goes through its gated API.
-    problems.extend(check_profiler_confinement())
-
-    return problems
+    problems += _legacy.check_phase_timer_span(cache=cache)
+    problems += _legacy.check_phase_timer_fork(files=files, cache=cache)
+    problems += _legacy.check_phase_timer_import(files=files, cache=cache)
+    problems += _legacy.check_trace_annotation(files=files, cache=cache)
+    problems += _legacy.check_resident_feed(cache=cache)
+    problems += _legacy.check_sharded_selection(cache=cache)
+    problems += _legacy.check_pipeline_coordinator(cache=cache)
+    problems += _legacy.check_fault_sites(files=files, cache=cache,
+                                          full_tree=True)
+    problems += _legacy.check_backward_registry(files=files, cache=cache,
+                                                full_tree=True)
+    problems += _legacy.check_profiler_confinement(files=files,
+                                                   cache=cache,
+                                                   full_tree=True)
+    return _render(problems)
 
 
-def _str_tuple(tree: ast.AST, name: str, rel: str, problems: list):
-    """Parse a module-level ``NAME = ("a", "b", ...)`` tuple of string
-    literals; returns None (with a finding) when absent/non-literal."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == name
-                for t in node.targets):
-            if not isinstance(node.value, (ast.Tuple, ast.List)):
-                break
-            names = []
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(elt.value,
-                                                                str):
-                    names.append(elt.value)
-                else:
-                    problems.append(
-                        f"{rel}:{elt.lineno}: {name} holds a non-literal "
-                        "entry — the registry must be statically "
-                        "checkable")
-            return names
-    problems.append(f"{rel}: {name} tuple not found — the backward "
-                    "registry has nothing to check against")
-    return None
+def check_resident_feed(trainer_path: str = None) -> list:
+    return _render(_legacy.check_resident_feed(
+        trainer_path if trainer_path is not None else TRAINER))
 
 
-def check_backward_registry(files=None, ops_path: str = OPS_BACKWARD,
-                            optim_path: str = OPTIM,
-                            tests_path: str = BACKWARD_TESTS) -> list:
-    """The gradient path's proven-backward invariant, statically
-    (check 9): custom VJPs only in ops/backward.py, every one named in
-    its ``TRAIN_PATH_VJPS`` and matched by ``PARITY_TESTED_VJPS`` in
-    tests/test_backward.py, and the fused optimizer-update functions
-    free of host materialization.  ``files`` given = a negative-case
-    unit test on a fragment (the custom_vjp location scan only)."""
-    problems = []
+def check_sharded_selection(kcenter_path: str = None) -> list:
+    return _render(_legacy.check_sharded_selection(
+        kcenter_path if kcenter_path is not None else KCENTER))
 
-    # a) custom_vjp usage is confined to ops/backward.py.
+
+def check_pipeline_coordinator(pipeline_path: str = None) -> list:
+    return _render(_legacy.check_pipeline_coordinator(
+        pipeline_path if pipeline_path is not None else PIPELINE))
+
+
+def check_fault_sites(files=None, registry_path: str = None) -> list:
     full_tree = files is None
-    paths = list(_py_files()) if full_tree else list(files)
-    for path in paths:
-        if os.path.abspath(path) == os.path.abspath(ops_path):
-            continue
-        rel = os.path.relpath(path, REPO)
-        try:
-            with open(path) as fh:
-                tree = ast.parse(fh.read())
-        except (OSError, SyntaxError) as e:
-            problems.append(f"{rel}: unreadable for the backward-registry "
-                            f"check ({e})")
-            continue
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Attribute) \
-                    and node.attr == "custom_vjp":
-                problems.append(
-                    f"{rel}:{node.lineno}: jax.custom_vjp outside "
-                    "ops/backward.py — hand-written backwards live in "
-                    "the closed registry (TRAIN_PATH_VJPS) so each one "
-                    "carries a gradient-parity test")
-    if not full_tree:
-        return problems
-
-    # b) the registry itself: TRAIN_PATH_VJPS names exist as defs and
-    # the module really uses custom_vjp.
-    rel_ops = os.path.relpath(ops_path, REPO)
-    try:
-        with open(ops_path) as fh:
-            ops_tree = ast.parse(fh.read())
-    except (OSError, SyntaxError) as e:
-        return problems + [f"{rel_ops}: unreadable for the "
-                           f"backward-registry check ({e})"]
-    registered = _str_tuple(ops_tree, "TRAIN_PATH_VJPS", rel_ops, problems)
-    if registered is None:
-        return problems
-    defs = {n.name for n in ast.walk(ops_tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    for name in registered:
-        if name not in defs:
-            problems.append(
-                f"{rel_ops}: TRAIN_PATH_VJPS names {name!r} but no such "
-                "function is defined — the registry drifted from the "
-                "module")
-    if not any(isinstance(n, ast.Attribute) and n.attr == "custom_vjp"
-               for n in ast.walk(ops_tree)):
-        problems.append(
-            f"{rel_ops}: no jax.custom_vjp usage found — TRAIN_PATH_VJPS "
-            "registers backwards that do not exist")
-
-    # c) every registered VJP has a registered parity test.
-    rel_tests = os.path.relpath(tests_path, REPO)
-    try:
-        with open(tests_path) as fh:
-            tests_tree = ast.parse(fh.read())
-    except (OSError, SyntaxError) as e:
-        return problems + [f"{rel_tests}: unreadable — every custom VJP "
-                           f"must carry a parity test ({e})"]
-    tested = _str_tuple(tests_tree, "PARITY_TESTED_VJPS", rel_tests,
-                        problems)
-    if tested is not None and set(tested) != set(registered):
-        problems.append(
-            f"{rel_tests}: PARITY_TESTED_VJPS {sorted(tested)} != "
-            f"TRAIN_PATH_VJPS {sorted(registered)} — a custom backward "
-            "without a registered gradient-parity test (or a stale test "
-            "registration) can never land")
-
-    # d) the fused update functions never touch the host.
-    rel_optim = os.path.relpath(optim_path, REPO)
-    try:
-        with open(optim_path) as fh:
-            optim_tree = ast.parse(fh.read())
-    except (OSError, SyntaxError) as e:
-        return problems + [f"{rel_optim}: unreadable for the fused-update "
-                           f"check ({e})"]
-    fused = _str_tuple(optim_tree, "FUSED_UPDATE_FNS", rel_optim, problems)
-    if fused is None:
-        return problems
-    fns = {n.name: n for n in ast.walk(optim_tree)
-           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    for name in fused:
-        fn = fns.get(name)
-        if fn is None:
-            problems.append(
-                f"{rel_optim}: FUSED_UPDATE_FNS names {name!r} but no "
-                "such function is defined")
-            continue
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Name) and node.id == "np":
-                problems.append(
-                    f"{rel_optim}:{node.lineno}: {name} references np — "
-                    "the fused update traces inside the donated train "
-                    "step and must never materialize state on the host")
-            if isinstance(node, ast.Call) \
-                    and isinstance(node.func, ast.Attribute) \
-                    and node.func.attr in _FUSED_HOST_CALLS:
-                problems.append(
-                    f"{rel_optim}:{node.lineno}: {name} calls "
-                    f".{node.func.attr}() — host materialization inside "
-                    "the fused optimizer update")
-    return problems
+    return _render(_legacy.check_fault_sites(
+        files=files if files is not None else list(_py_files()),
+        registry_path=(registry_path if registry_path is not None
+                       else FAULTS_REGISTRY),
+        full_tree=full_tree))
 
 
-def check_profiler_confinement(files=None,
-                               profiler_path: str = PROFILER) -> list:
-    """The device-truth layer's one-gate invariant, statically
-    (check 10): ``jax.profiler`` imports/attribute access and
-    ``start_trace``/``stop_trace`` calls are confined to
-    telemetry/profiler.py, and that module really defines the gated API
-    and touches jax.profiler.  ``files`` given = a negative-case unit
-    test on a fragment (the confinement scan only)."""
-    problems = []
+def check_backward_registry(files=None, ops_path: str = None,
+                            optim_path: str = None,
+                            tests_path: str = None) -> list:
     full_tree = files is None
-    paths = list(_py_files()) if full_tree else list(files)
-    for path in paths:
-        if os.path.abspath(path) == os.path.abspath(profiler_path):
-            continue
-        rel = os.path.relpath(path, REPO)
-        try:
-            with open(path) as fh:
-                tree = ast.parse(fh.read())
-        except (OSError, SyntaxError) as e:
-            problems.append(f"{rel}: unreadable for the profiler-"
-                            f"confinement check ({e})")
-            continue
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    if alias.name == "jax.profiler" \
-                            or alias.name.startswith("jax.profiler."):
-                        problems.append(
-                            f"{rel}:{node.lineno}: imports jax.profiler "
-                            "outside telemetry/profiler.py — capture "
-                            "windows and device annotations go through "
-                            "the gated API (DESIGN.md §11)")
-            if isinstance(node, ast.ImportFrom) and node.module:
-                if (node.module == "jax"
-                        and any(a.name == "profiler"
-                                for a in node.names)) \
-                        or node.module.startswith("jax.profiler"):
-                    problems.append(
-                        f"{rel}:{node.lineno}: imports jax's profiler "
-                        "outside telemetry/profiler.py — use the gated "
-                        "API")
-            if isinstance(node, ast.Attribute) \
-                    and node.attr == "profiler" \
-                    and isinstance(node.value, ast.Name) \
-                    and node.value.id == "jax":
-                problems.append(
-                    f"{rel}:{node.lineno}: touches jax.profiler outside "
-                    "telemetry/profiler.py — the device-truth layer is "
-                    "the one gate")
-            if isinstance(node, ast.Call):
-                fn = node.func
-                called = (fn.attr if isinstance(fn, ast.Attribute)
-                          else fn.id if isinstance(fn, ast.Name) else "")
-                if called in _CAPTURE_CALLS:
-                    problems.append(
-                        f"{rel}:{node.lineno}: calls {called}() outside "
-                        "telemetry/profiler.py — every capture window "
-                        "goes through the gated API (capture_window/"
-                        "start_capture/finish_capture)")
-    if not full_tree:
-        return problems
+    return _render(_legacy.check_backward_registry(
+        files=files if files is not None else list(_py_files()),
+        ops_path=ops_path if ops_path is not None else OPS_BACKWARD,
+        optim_path=optim_path if optim_path is not None else OPTIM,
+        tests_path=tests_path if tests_path is not None else BACKWARD_TESTS,
+        full_tree=full_tree))
 
-    # The gate module itself: the API exists and jax.profiler is really
-    # touched (otherwise the confinement above confines nothing).
-    rel = os.path.relpath(profiler_path, REPO)
-    try:
-        with open(profiler_path) as fh:
-            gate_tree = ast.parse(fh.read())
-    except (OSError, SyntaxError) as e:
-        return problems + [f"{rel}: unreadable for the profiler-gate "
-                           f"check ({e})"]
-    defs = {n.name for n in ast.walk(gate_tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    for name in _PROFILER_GATE_FNS:
-        if name not in defs:
-            problems.append(
-                f"{rel}: gated API function {name} not found — the "
-                "capture-window enforcement has nothing to point at")
-    touches = any(
-        isinstance(n, ast.Import) and any(
-            a.name == "jax.profiler" for a in n.names)
-        for n in ast.walk(gate_tree))
-    if not touches:
-        problems.append(
-            f"{rel}: never imports jax.profiler — the gate module is "
-            "not actually the gate")
-    return problems
+
+def check_profiler_confinement(files=None, profiler_path: str = None
+                               ) -> list:
+    full_tree = files is None
+    return _render(_legacy.check_profiler_confinement(
+        files=files if files is not None else list(_py_files()),
+        profiler_path=(profiler_path if profiler_path is not None
+                       else PROFILER),
+        full_tree=full_tree))
 
 
 def _registered_fault_sites(registry_path: str, problems: list):
-    """Parse faults/registry.py's ``SITES`` tuple; duplicate names are a
-    finding (each site registered EXACTLY once)."""
-    rel = os.path.relpath(registry_path, REPO)
-    try:
-        with open(registry_path) as fh:
-            tree = ast.parse(fh.read())
-    except (OSError, SyntaxError) as e:
-        problems.append(f"{rel}: unreadable for the fault-site check ({e})")
-        return None
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "SITES"
-                for t in node.targets):
-            if not isinstance(node.value, (ast.Tuple, ast.List)):
-                break
-            names = []
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(elt.value,
-                                                                str):
-                    names.append(elt.value)
-                else:
-                    problems.append(
-                        f"{rel}:{elt.lineno}: SITES holds a non-literal "
-                        "entry — the registry must be statically "
-                        "checkable")
-            for name in set(names):
-                if names.count(name) > 1:
-                    problems.append(
-                        f"{rel}: site {name!r} registered more than once "
-                        "in SITES — each site is registered exactly once")
-            return names
-    problems.append(f"{rel}: SITES tuple not found — the fault-site "
-                    "registry has nothing to check against")
-    return None
-
-
-def check_fault_sites(files=None,
-                      registry_path: str = FAULTS_REGISTRY) -> list:
-    """The failure model's closed-registry invariant, statically
-    (check 8): every ``faults.site()``/``site()`` call names a
-    registered site as a string literal, every registered site is wired
-    at ≥1 call site (full-tree mode only — ``files`` given means a
-    negative-case unit test on a fragment), and every ``RetryPolicy``
-    construction passes ``classify=`` explicitly."""
-    problems = []
-    registered = _registered_fault_sites(registry_path, problems)
-    if registered is None:
-        return problems
-    full_tree = files is None
-    paths = list(_py_files()) if full_tree else list(files)
-    wired = set()
-    for path in paths:
-        if os.path.abspath(path) == os.path.abspath(registry_path):
-            continue  # the definition site, not a call site
-        rel = os.path.relpath(path, REPO)
-        try:
-            with open(path) as fh:
-                tree = ast.parse(fh.read())
-        except (OSError, SyntaxError) as e:
-            problems.append(f"{rel}: unreadable for the fault-site "
-                            f"check ({e})")
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            is_site = (
-                (isinstance(fn, ast.Attribute) and fn.attr == "site"
-                 and isinstance(fn.value, ast.Name)
-                 and fn.value.id == "faults")
-                or (isinstance(fn, ast.Name) and fn.id == "site"))
-            is_retry = ((isinstance(fn, ast.Attribute)
-                         and fn.attr == "RetryPolicy")
-                        or (isinstance(fn, ast.Name)
-                            and fn.id == "RetryPolicy"))
-            if is_site:
-                arg = node.args[0] if node.args else None
-                if not (isinstance(arg, ast.Constant)
-                        and isinstance(arg.value, str)):
-                    problems.append(
-                        f"{rel}:{node.lineno}: faults.site() with a "
-                        "non-literal site name — the closed registry "
-                        "cannot be checked")
-                elif arg.value not in registered:
-                    problems.append(
-                        f"{rel}:{node.lineno}: faults.site({arg.value!r}) "
-                        "names an unregistered site (registry: "
-                        "faults/registry.py SITES)")
-                else:
-                    wired.add(arg.value)
-            if is_retry and not any(kw.arg == "classify"
-                                    for kw in node.keywords):
-                problems.append(
-                    f"{rel}:{node.lineno}: RetryPolicy(...) without an "
-                    "explicit classify= — every retry call site states "
-                    "its transient-vs-fatal rule (no bare retries)")
-    if full_tree:
-        for name in registered:
-            if name not in wired:
-                problems.append(
-                    f"faults/registry.py: site {name!r} is registered "
-                    "but wired at no call site — chaos coverage for it "
-                    "is vacuous")
-    return problems
-
-
-def check_resident_feed(trainer_path: str = TRAINER) -> list:
-    """The zero-host-copy invariant, statically: the trainer functions in
-    RESIDENT_FEED_FNS may look up the shared device cache and do index
-    math, but any ``np.`` reference or host-materializing call
-    (``.gather``/``.asarray``/``.concatenate``/...) inside them means an
-    image array crossed back to the host on the resident feed path."""
-    problems = []
-    rel = os.path.relpath(trainer_path, REPO)
-    try:
-        with open(trainer_path) as fh:
-            tree = ast.parse(fh.read())
-    except (OSError, SyntaxError) as e:
-        return [f"{rel}: unreadable for the resident-feed check ({e})"]
-    fns = {node.name: node for node in ast.walk(tree)
-           if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    for name in RESIDENT_FEED_FNS:
-        fn = fns.get(name)
-        if fn is None:
-            problems.append(
-                f"{rel}: resident-feed function {name} not found — the "
-                "zero-host-copy enforcement has nothing to check")
-            continue
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Name) and node.id == "np":
-                problems.append(
-                    f"{rel}:{node.lineno}: {name} references np — the "
-                    "resident train feed must never materialize image "
-                    "arrays on the host")
-            if isinstance(node, ast.Call) \
-                    and isinstance(node.func, ast.Attribute) \
-                    and node.func.attr in _HOST_COPY_CALLS:
-                problems.append(
-                    f"{rel}:{node.lineno}: {name} calls "
-                    f".{node.func.attr}() — host materialization on the "
-                    "resident train feed path")
-    return problems
-
-
-def check_sharded_selection(kcenter_path: str = KCENTER) -> list:
-    """The sharded pool's scale-out invariant, statically (check 6): the
-    row-sharded selection backend may move O(N) vectors and O(q) rows,
-    but a ``jax.device_get``/``np.asarray`` of the pool, an ``np.``
-    reference in the device tier, or a ``replicate``/
-    ``replicated_sharding`` call means the [N, D] factor matrix came
-    back whole onto one host or chip — the exact ceiling the backend
-    exists to break."""
-    problems = []
-    rel = os.path.relpath(kcenter_path, REPO)
-    try:
-        with open(kcenter_path) as fh:
-            tree = ast.parse(fh.read())
-    except (OSError, SyntaxError) as e:
-        return [f"{rel}: unreadable for the sharded-selection check ({e})"]
-    fns = {node.name: node for node in ast.walk(tree)
-           if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
-
-    def call_name(node) -> str:
-        if isinstance(node, ast.Call):
-            if isinstance(node.func, ast.Attribute):
-                return node.func.attr
-            if isinstance(node.func, ast.Name):
-                return node.func.id
-        return ""
-
-    for name in SHARDED_DEVICE_FNS + SHARDED_ORCHESTRATOR_FNS:
-        fn = fns.get(name)
-        if fn is None:
-            problems.append(
-                f"{rel}: sharded-selection function {name} not found — "
-                "the scale-out enforcement has nothing to check")
-            continue
-        device_tier = name in SHARDED_DEVICE_FNS
-        for node in ast.walk(fn):
-            if device_tier and isinstance(node, ast.Name) \
-                    and node.id == "np":
-                problems.append(
-                    f"{rel}:{node.lineno}: {name} references np — the "
-                    "sharded selection backend must never materialize "
-                    "pool state on the host")
-            called = call_name(node)
-            if device_tier and called in _SHARDED_HOST_CALLS:
-                problems.append(
-                    f"{rel}:{node.lineno}: {name} calls .{called}() — "
-                    "host materialization inside the sharded selection "
-                    "backend")
-            if not device_tier and called == "device_get":
-                problems.append(
-                    f"{rel}:{node.lineno}: {name} calls device_get — "
-                    "the sharded pool must never round-trip to host")
-            if called in _SHARDED_REPLICATE_CALLS:
-                problems.append(
-                    f"{rel}:{node.lineno}: {name} calls {called}() — "
-                    "replicating a row-sharded array rebuilds the "
-                    "single-chip ceiling the sharded pool removes")
-    return problems
-
-
-def check_pipeline_coordinator(pipeline_path: str = PIPELINE) -> list:
-    """The pipelined round's overlap invariant, statically (check 7):
-    the speculative-scoring coordinator functions may enqueue device
-    work and wait on host-side conditions, but a ``block_until_ready``
-    or ``device_get`` call inside them would sync the train stream's
-    arrays — serializing the two streams the pipeline exists to
-    overlap.  Chunk-output fetches live inside collect_pool (scoring
-    tier), and the CPU-only execution drain lives in
-    mesh_lib.DispatchGate; neither is a coordinator function."""
-    problems = []
-    rel = os.path.relpath(pipeline_path, REPO)
-    try:
-        with open(pipeline_path) as fh:
-            tree = ast.parse(fh.read())
-    except (OSError, SyntaxError) as e:
-        return [f"{rel}: unreadable for the pipeline-coordinator "
-                f"check ({e})"]
-    fns = {node.name: node for node in ast.walk(tree)
-           if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    for name in PIPELINE_COORDINATOR_FNS:
-        fn = fns.get(name)
-        if fn is None:
-            problems.append(
-                f"{rel}: pipeline coordinator function {name} not found "
-                "— the never-sync enforcement has nothing to check")
-            continue
-        for node in ast.walk(fn):
-            called = ""
-            if isinstance(node, ast.Call):
-                if isinstance(node.func, ast.Attribute):
-                    called = node.func.attr
-                elif isinstance(node.func, ast.Name):
-                    called = node.func.id
-            if called in _PIPELINE_SYNC_CALLS:
-                problems.append(
-                    f"{rel}:{node.lineno}: {name} calls {called} — the "
-                    "speculative-scoring coordinator must never sync "
-                    "the train stream (DESIGN.md §8)")
-    return problems
+    """Legacy helper: parse the SITES tuple, appending rendered problem
+    strings into the caller's list."""
+    inner = []
+    names = _legacy.registered_fault_sites(registry_path, inner)
+    problems.extend(_render(inner))
+    return names
 
 
 def main() -> int:

@@ -1,0 +1,8 @@
+# Golden negative case for check id ``backward-registry``: a custom VJP
+# dodging the ops/backward.py closed registry.
+import jax
+
+
+@jax.custom_vjp
+def sneaky(x):
+    return x

@@ -1,0 +1,18 @@
+# Golden negative case for check id ``collective-axis``: a collective
+# over an unregistered axis literal, and the masked-psum owner-gather
+# idiom hand-rolled outside parallel/mesh.owner_rows.
+import jax
+import jax.numpy as jnp
+
+
+def gather_rows(pool, idxs):
+    rows = pool[idxs]
+    # VIOLATION: "rows" is not a *_AXIS constant in parallel/mesh.py.
+    return jax.lax.psum(rows, "rows")
+
+
+def owner_gather(arr, mask, axis="data"):
+    picked = jnp.where(mask, arr, jnp.zeros((), arr.dtype))
+    # VIOLATION: psum of a where-masked operand — the one spelling of
+    # the owner-gather idiom is mesh_lib.owner_rows.
+    return jax.lax.psum(picked, axis)

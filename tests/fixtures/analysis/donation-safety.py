@@ -1,0 +1,17 @@
+# Golden negative case for check id ``donation-safety``: the donated
+# state is read again after the call handed its buffer to XLA.
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+def train(state, batches):
+    out = step(state, batches[0])
+    # VIOLATION: ``state``'s buffer was donated into the call above —
+    # this read touches a deleted device array.
+    return out + state.sum()

@@ -1,0 +1,10 @@
+# Golden negative case for check id ``fault-sites``: a typo'd site name,
+# a non-literal site, and a RetryPolicy without classify=.
+from active_learning_tpu import faults
+
+
+def upload(name):
+    faults.site("h2d_uplaod")  # typo'd: not in the registry
+    faults.site(name)  # non-literal
+    p = faults.RetryPolicy(site="x")  # no classify=
+    return p

@@ -1,0 +1,20 @@
+# Golden negative case for check id ``lock-discipline``: a field the
+# registry declares guarded, read outside its lock by a second method.
+import threading
+
+_GUARDED_BY = {"_queue": "_lock"}
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+
+    def push(self, item):
+        with self._lock:
+            self._queue.append(item)
+
+    def steal(self):
+        # VIOLATION: bare read-modify-write of the guarded deque — the
+        # exact cross-thread race the checker exists to catch.
+        return self._queue.pop()

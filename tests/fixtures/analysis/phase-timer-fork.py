@@ -1,0 +1,4 @@
+# Golden negative case for check id ``phase-timer-fork``: a competing
+# phase_timer definition outside utils/tracing.py.
+def phase_timer(name):
+    return name

@@ -1,0 +1,6 @@
+# Golden negative case for check id ``phase-timer-import``: calls
+# phase_timer without importing it from utils.tracing (a local copy or
+# star-import would bypass the one-measurement contract).
+def run_round(metrics):
+    with phase_timer("query", metrics):  # noqa: F821 - the point
+        pass

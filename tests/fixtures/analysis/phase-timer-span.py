@@ -1,0 +1,13 @@
+# Golden negative case for check id ``phase-timer-span``: a phase_timer
+# that measures with its own clock instead of opening a tracer span —
+# metrics and trace would silently fork.
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def phase_timer(name, metrics=None, round_idx=None):
+    t0 = time.perf_counter()
+    yield
+    if metrics is not None:
+        metrics(f"rd_{name}", time.perf_counter() - t0, round_idx)

@@ -1,0 +1,31 @@
+# Golden negative case for check id ``pipeline-coordinator``: a
+# coordinator function that syncs the train stream.
+import jax
+
+
+def _worker(self):
+    jax.block_until_ready(self.out)
+
+
+def _worker_loop(self):
+    pass
+
+
+def _score_slice(self, plan, sl, variables):
+    return jax.device_get(variables)
+
+
+def _score_chunk(self, plan, sl, tag, variables, i):
+    return None
+
+
+def publish_best(self, r, e, v):
+    pass
+
+
+def finalize(self, r, e):
+    pass
+
+
+def consume(self, kind, keys, idxs, bs, variables):
+    return None

@@ -1,0 +1,8 @@
+# Golden negative case for check id ``profiler-confinement``: touching
+# jax.profiler outside the telemetry/profiler.py gate.
+import jax.profiler
+
+
+def capture(d):
+    jax.profiler.start_trace(d)
+    jax.profiler.stop_trace()

@@ -1,0 +1,28 @@
+# Golden negative case for check id ``recompile-hazard``: a jit outside
+# the registered step-builders, plus an f-string static operand (a fresh
+# object per call = a recompile per call).
+import functools
+
+import jax
+
+_STEP_BUILDERS = ("build_step",)
+
+
+def build_step(model):
+    @jax.jit
+    def step(variables, batch):
+        return model(variables, batch)
+
+    return step
+
+
+# VIOLATION: a jitted def not named in _STEP_BUILDERS.
+@functools.partial(jax.jit, static_argnames=("mode",))
+def rogue_step(x, mode):
+    return x
+
+
+def call_it(x):
+    # VIOLATION: an f-string as a static operand — a new string value
+    # per distinct x, a new executable per distinct value.
+    return rogue_step(x, mode=f"mode-{x}")

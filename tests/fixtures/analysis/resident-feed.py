@@ -1,0 +1,12 @@
+# Golden negative case for check id ``resident-feed``: a resident-feed
+# trainer function that materializes image rows on the host.
+import numpy as np
+
+
+def _resident_feed_arrays(self, train_set):
+    rows = np.asarray(train_set.gather(self.idxs))
+    return rows, None
+
+
+def _build_resident_batch_step(self):
+    return None

@@ -1,0 +1,15 @@
+# Golden negative case for check id ``sharded-selection``: the sharded
+# backend pulling the factor matrix whole onto host / replicating it.
+import jax
+import numpy as np
+
+
+def _build_sharded_fns(mesh, nf):
+    rows = np.asarray(jax.device_get(mesh))
+    return rows
+
+
+def _kcenter_greedy_sharded(factors, mask, budget):
+    full = jax.device_get(factors)
+    rep = mesh_lib.replicate(factors, None)  # noqa: F821
+    return full, rep

@@ -1,0 +1,7 @@
+# Golden negative case for check id ``trace-annotation``: uses
+# jax.profiler.TraceAnnotation directly instead of utils.tracing.annotate.
+import jax
+
+
+def annotate(name):
+    return jax.profiler.TraceAnnotation(name)
