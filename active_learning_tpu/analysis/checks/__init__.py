@@ -1,4 +1,4 @@
-"""The checker registry: 10 ported legacy checks + 4 deep checkers.
+"""The checker registry: 10 ported legacy checks + 5 deep checkers.
 
 Ordered — the CLI lists and runs them in this order, and the per-check
 fixture test parametrizes over it.  Adding a check = appending here
@@ -12,12 +12,14 @@ from .lock_discipline import LockDisciplineChecker
 from .donation import DonationSafetyChecker
 from .recompile import RecompileHazardChecker
 from .collective_axis import CollectiveAxisChecker
+from .diagnostics_inert import DiagnosticsInertChecker
 
 DEEP_CHECKERS = (
     LockDisciplineChecker(),
     DonationSafetyChecker(),
     RecompileHazardChecker(),
     CollectiveAxisChecker(),
+    DiagnosticsInertChecker(),
 )
 
 CHECKERS = tuple(LEGACY_CHECKERS) + DEEP_CHECKERS

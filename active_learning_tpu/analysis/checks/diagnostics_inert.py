@@ -1,0 +1,157 @@
+"""diagnostics-inert: the experiment-truth layer may never touch the
+device, and hot paths may only reach it through a flag gate.
+
+The diagnostics layer (telemetry/diagnostics.py, DESIGN.md §13) rides
+numbers that already exist on host — acquisition scores, pick
+distances, eval counts.  Its whole off-path contract (disabled = one
+None check per site, <2.5µs/call; enabled = zero extra device syncs in
+strategy hot paths) holds only as long as two properties stay true, so
+this checker proves them statically instead of trusting review:
+
+  1. **Host purity.**  A module declaring ``_DIAGNOSTICS_HOST_PURE =
+     True`` (the diagnostics module's marker) may not import jax in any
+     form, reference the ``jax`` name, or call a device-sync primitive
+     (``block_until_ready`` / ``device_get`` / ``device_put`` /
+     ``copy_to_host_async``).  numpy + stdlib only: the module can only
+     consume arrays that are ALREADY host arrays — it is structurally
+     incapable of adding a hidden device round-trip.
+
+  2. **Gated call sites.**  Any function that reads a ``.diagnostics``
+     attribute (the strategy/driver hook surface) must contain an
+     ``if``/ternary/``while`` whose test mentions a ``diag``-named
+     value — the single flag check the off-path cost bound pins.  An
+     ungated read is a hook that runs unconditionally on the hot path.
+     ``__init__``/``__new__`` are exempt (construction is the one place
+     the attribute is ASSIGNED, not consumed).
+
+Like lock-discipline, the walk is LEXICAL: a gate anywhere in the
+function satisfies rule 2 even for code before it (the early-return
+``if self.diagnostics is None: return`` idiom), and aliases hoisted
+across functions are not tracked — the same cheap trade every
+annotation-based checker makes.
+
+Suppression: ``# al-lint: diag-ok <reason>`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Checker, Context
+from ..findings import Finding
+
+# Device-sync attribute calls forbidden inside a host-pure module.
+_SYNC_CALLS = {"block_until_ready", "device_get", "device_put",
+               "copy_to_host_async"}
+_EXEMPT_FNS = {"__init__", "__new__"}
+
+
+def _declares_host_pure(tree: ast.Module) -> bool:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            names = {t.id for t in node.targets
+                     if isinstance(t, ast.Name)}
+            if "_DIAGNOSTICS_HOST_PURE" in names:
+                return (isinstance(node.value, ast.Constant)
+                        and node.value.value is True)
+    return False
+
+
+def _mentions_diag(expr: ast.AST) -> bool:
+    """Whether an expression references a diag-named value (``diag``,
+    ``self.diagnostics``, ``strategy.diagnostics``, ...)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and "diag" in node.id:
+            return True
+        if isinstance(node, ast.Attribute) and "diag" in node.attr:
+            return True
+    return False
+
+
+class DiagnosticsInertChecker(Checker):
+    id = "diagnostics-inert"
+    title = ("the diagnostics layer is host-pure and its hot-path hooks "
+             "are flag-gated")
+    suppress_token = "diag-ok"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        problems: List[Finding] = []
+        for path in ctx.files:
+            tree, err = ctx.tree(path)
+            if err is not None:
+                continue  # parse failures are the legacy checks' finding
+            rel = ctx.rel(path)
+            if _declares_host_pure(tree):
+                self._check_host_pure(tree, rel, problems)
+            self._check_gated_access(tree, rel, problems)
+        return problems
+
+    # -- rule 1: host purity ----------------------------------------------
+
+    def _check_host_pure(self, tree, rel, problems):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "jax":
+                        problems.append(self._pure_finding(
+                            rel, node.lineno,
+                            "imports jax — the host-pure diagnostics "
+                            "module must stay numpy+stdlib (it can only "
+                            "consume arrays already on host)"))
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "jax":
+                    problems.append(self._pure_finding(
+                        rel, node.lineno,
+                        "imports from jax — the host-pure diagnostics "
+                        "module must stay numpy+stdlib"))
+            elif isinstance(node, ast.Name) and node.id == "jax":
+                problems.append(self._pure_finding(
+                    rel, node.lineno,
+                    "references the jax name inside a host-pure "
+                    "diagnostics module"))
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _SYNC_CALLS):
+                problems.append(self._pure_finding(
+                    rel, node.lineno,
+                    f"calls {node.func.attr}() — a device sync/transfer "
+                    "inside the host-pure diagnostics module"))
+
+    def _pure_finding(self, rel, line, message):
+        return Finding(
+            check=self.id, path=rel, line=line,
+            message=f"host-purity violation: {message}",
+            hint="move device work to the caller (hand host arrays in), "
+                 "or annotate '# al-lint: diag-ok <reason>'")
+
+    # -- rule 2: gated hook sites -----------------------------------------
+
+    def _check_gated_access(self, tree, rel, problems):
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name in _EXEMPT_FNS:
+                continue
+            gated = any(
+                isinstance(node, (ast.If, ast.IfExp, ast.While))
+                and _mentions_diag(node.test)
+                for node in ast.walk(fn))
+            if gated:
+                continue
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Attribute)
+                        and node.attr == "diagnostics"
+                        and isinstance(node.ctx, ast.Load)):
+                    problems.append(Finding(
+                        check=self.id, path=rel, line=node.lineno,
+                        message=(f"'{fn.name}' reads .diagnostics with "
+                                 "no flag gate anywhere in the function "
+                                 "— an unconditional hook on a hot "
+                                 "path (the off-path contract is one "
+                                 "None/flag check per site)"),
+                        hint="guard with 'if ...diagnostics is None: "
+                             "return' (or an if/ternary naming the "
+                             "flag), or annotate "
+                             "'# al-lint: diag-ok <reason>'"))

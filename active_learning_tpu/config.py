@@ -308,6 +308,15 @@ class TelemetryConfig:
     # disables.  The serve path exposes the same exposition format live
     # at /metrics?format=prometheus.
     prometheus_file: Optional[str] = None
+    # The experiment-truth diagnostics layer (telemetry/diagnostics.py,
+    # DESIGN.md §13): per-round acquisition-score histograms + PSI/JS
+    # drift, selection composition (class balance / novelty / k-center
+    # pick distances), and eval-piggybacked calibration, emitted through
+    # the sink + al_run_* gauges and persisted into run_report.json.
+    # Default ON (it rides numbers that already exist on host — zero
+    # extra pool passes, zero device syncs, picks bit-identical on/off);
+    # requires ``enabled``.  Off = one None check per hook site.
+    diagnostics: bool = True
     # What a CONFIRMED stall does beyond logging (DESIGN.md §10):
     #   "log"       log + stall_suspected metric (the pre-fault-model
     #               behavior);
@@ -360,6 +369,12 @@ class ExperimentConfig:
     # Comma-separated sink backends (utils/metrics.SINK_BACKENDS):
     # "jsonl", "csv", "tensorboard", or combinations ("jsonl,tensorboard").
     metrics_backend: str = "jsonl"
+    # JsonlSink size-based rotation: when metrics.jsonl would exceed
+    # this many bytes it rotates to metrics.jsonl.1 (atomic, lock-held,
+    # no line ever split across the boundary — utils/metrics.JsonlSink).
+    # 0 (default) = unbounded, the historical behavior; a
+    # run-indefinitely service (ROADMAP item 3) sets a cap.
+    metrics_rotate_bytes: int = 0
 
     # Dataset
     dataset: str = "cifar10"

@@ -34,6 +34,10 @@ def get_parser() -> argparse.ArgumentParser:
                    help="replaces --enable_comet (metrics on by default)")
     p.add_argument("--metrics_backend", type=str, default="jsonl",
                    help="comma-separated sinks: jsonl, csv, tensorboard")
+    p.add_argument("--metrics_rotate_bytes", type=int, default=0,
+                   help="rotate metrics.jsonl to metrics.jsonl.1 past "
+                        "this many bytes (atomic, no lost lines); 0 = "
+                        "unbounded (default)")
     # Dataset (parser.py:27-39)
     p.add_argument("--dataset", type=str, default="cifar10",
                    choices=["cifar10", "imbalanced_cifar10", "imagenet",
@@ -105,6 +109,12 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--prometheus_file", type=str, default=None,
                    help="atomically rewrite this Prometheus textfile-"
                         "collector scrape file with run gauges")
+    p.add_argument("--disable_diagnostics", action="store_true",
+                   help="turn off the experiment-truth diagnostics "
+                        "layer (score histograms + rd_score_drift_*, "
+                        "selection composition, calibration — "
+                        "DESIGN.md §13).  On by default; picks and "
+                        "experiment state are bit-identical either way")
     p.add_argument("--watchdog_action", type=str, default="log",
                    choices=["log", "snapshot", "degrade"],
                    help="what a confirmed stall does beyond logging: "
@@ -237,6 +247,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         ckpt_path=args.ckpt_path,
         enable_metrics=not args.disable_metrics,
         metrics_backend=args.metrics_backend,
+        metrics_rotate_bytes=args.metrics_rotate_bytes,
         dataset=args.dataset,
         dataset_dir=args.dataset_dir,
         arg_pool=args.arg_pool,
@@ -266,6 +277,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
             watchdog=args.watchdog,
             stall_deadline_s=args.stall_deadline_s,
             prometheus_file=args.prometheus_file,
+            diagnostics=not args.disable_diagnostics,
             watchdog_action=args.watchdog_action),
         fault_spec=args.fault_spec,
         dtype=args.dtype,
@@ -316,6 +328,13 @@ def main(argv: Optional[List[str]] = None):
     if argv and argv[0] == "status":
         from ..telemetry.status import main as status_main
         return status_main(argv[1:])
+    # ``report``: render a run's label-efficiency curve — or a
+    # cross-run strategy comparison at matched label budgets — from
+    # run_report.json / metrics.jsonl (telemetry/report.py; stdlib
+    # only, no jax import, same contract as ``status``).
+    if argv and argv[0] == "report":
+        from ..telemetry.report import main as report_main
+        return report_main(argv[1:])
     from ..faults.preempt import PreemptionRequested
     from .driver import run_experiment
     args = get_parser().parse_args(argv)

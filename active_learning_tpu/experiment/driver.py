@@ -23,6 +23,7 @@ from __future__ import annotations
 import copy
 import dataclasses
 import os
+import time
 import uuid
 import zlib
 from datetime import date
@@ -41,6 +42,7 @@ from ..models.factory import get_network
 from ..parallel import mesh as mesh_lib
 from ..pool import PoolState
 from ..strategies import get_strategy
+from ..telemetry import diagnostics as diag_lib
 from ..telemetry import profiler as tele_profiler
 from ..telemetry import runtime as tele_runtime
 from ..telemetry import spans as tele_spans
@@ -377,11 +379,24 @@ def build_experiment(
 # strategy-owned and ride the heartbeat/status path instead).  The
 # device-truth metrics (telemetry/profiler.RoundProfiler.emit_metrics)
 # register dynamically the same way: sink + gauges from one dict.
+# The experiment-truth diagnostics gauges (telemetry/diagnostics.py,
+# DESIGN.md §13): score-distribution summary + inter-round drift,
+# selection composition, k-center pick distances, calibration — emitted
+# through _emit_round_gauges whenever the strategy's diagnostics layer
+# produced them that round, and POPPED from the scrape gauges on any
+# round that did not (the honesty rule reaches the scrape: a drift the
+# current round could not compute must not linger looking current).
+DIAGNOSTICS_GAUGES = (
+    "rd_score_mean", "rd_score_std", "rd_score_drift_psi",
+    "rd_score_drift_js", "rd_pick_class_balance", "rd_pick_novelty",
+    "rd_pick_min_dist", "rd_pick_mean_dist", "rd_ece",
+)
+
 PER_ROUND_GAUGES = (
     "rd_round_time", "overlap_frac", "round_vs_max_phase",
     "rd_spec_score_time", "jit_cache_miss_delta", "fault_retries_total",
     "degrade_events", "hbm_peak_gb",
-)
+) + DIAGNOSTICS_GAUGES
 
 
 def _emit_round_gauges(telemetry, sink: MetricsSink, rd: int,
@@ -440,6 +455,23 @@ def _emit_round_telemetry(telemetry, sink: MetricsSink, rd: int,
     trace export so a crash mid-run still leaves trace.json on disk."""
     if not telemetry.train_metrics:
         return
+    # The experiment-truth layer's round close-out (DESIGN.md §13):
+    # drift vs the previous scored round, score summary, composition,
+    # calibration — through the SAME one-dict-two-channels spelling as
+    # every other per-round metric (the PER_ROUND_GAUGES completeness
+    # contract covers them automatically).
+    diag = getattr(strategy, "diagnostics", None)
+    if diag is not None:
+        diag_gauges = diag.finish_round(rd)
+        _emit_round_gauges(telemetry, sink, rd, diag_gauges)
+        # Any diagnostics gauge THIS round produced no value for is
+        # popped from the scrape set (set_gauges drops on None): a
+        # below-MIN_DRIFT_N round must retract last round's drift, not
+        # let it scrape as current.
+        stale = {k: None for k in DIAGNOSTICS_GAUGES
+                 if diag_gauges.get(k) is None}
+        if stale:
+            telemetry.set_gauges(**stale)
     # Per-RUN retries: the process counter is cumulative across every
     # run/phase sharing this interpreter (bench runs many), so the
     # run-start baseline is subtracted — the al_round retries rider must
@@ -532,6 +564,11 @@ def _restore_round_snapshot(strategy, snap: dict,
         # arrays the failed attempt donated are never read again.
         strategy.state = strategy.trainer.replace_variables(
             strategy.state, snap["variables"])
+    # The failed attempt's partial diagnostics must not double-count
+    # into the retried round (the previous round's drift reference
+    # survives — reset_round clears the CURRENT accumulators only).
+    if strategy.diagnostics is not None:
+        strategy.diagnostics.reset_round()
 
 
 def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
@@ -676,7 +713,8 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         # Metrics/assets are run-level side effects: process 0 only.
         sink = make_sink(cfg.enable_metrics and mesh_lib.is_coordinator(),
                          cfg.log_dir, experiment_key=key,
-                         backend=cfg.metrics_backend)
+                         backend=cfg.metrics_backend,
+                         rotate_bytes=cfg.metrics_rotate_bytes)
     # The round journal (faults/journal.py): WHERE the run is — round/
     # phase/attempt, labeled-set digest, active degradation rungs,
     # terminal status — atomically rewritten next to the heartbeat so
@@ -798,6 +836,47 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
             f"Budget used before starting: {strategy.pool.num_labeled}")
         logger.info(f"Log file name: {log_filename}")
         logger.info(f"Mesh: {strategy.mesh.devices.size} devices")
+
+        # The per-run report artifact (telemetry/diagnostics.py,
+        # DESIGN.md §13): the label-efficiency curve — accuracy vs
+        # labeled count vs wall-clock per round, plus the round's
+        # drift/composition/calibration diagnostics — atomically
+        # rewritten as run_report.json after every round, so a crashed
+        # or preempted run still leaves a renderable artifact
+        # (`python -m active_learning_tpu report <log_dir>` /
+        # scripts/run_report.py).  On resume, completed rounds' rows
+        # are merged back from the prior file.
+        run_report_path = os.path.join(cfg.log_dir,
+                                       diag_lib.RUN_REPORT_FILE)
+        write_report = mesh_lib.is_coordinator() and cfg.enable_metrics
+        report_rows: list = []
+        # Resumed segments continue the CUMULATIVE wall clock from the
+        # last merged row (accuracy-vs-time must stay monotone across a
+        # preemption; a fresh-zero clock would make round N+1 look
+        # cheaper than round N).  Preemption downtime is not counted —
+        # the curve measures compute time spent, not queue luck.
+        report_wall_base = 0.0
+        if write_report and start_round > 0:
+            prior_report = diag_lib.read_run_report(run_report_path)
+            if prior_report and prior_report.get("exp_hash") == \
+                    cfg.exp_hash:
+                report_rows = [
+                    r for r in prior_report.get("rounds", [])
+                    if isinstance(r, dict)
+                    and isinstance(r.get("round"), int)
+                    and r["round"] < start_round]
+                report_wall_base = max(
+                    (float(r.get("wall_clock_s") or 0.0)
+                     for r in report_rows), default=0.0)
+        report_header = {
+            "exp_name": cfg.exp_name, "exp_hash": cfg.exp_hash,
+            "strategy": cfg.strategy, "dataset": cfg.dataset,
+            "model": cfg.model, "run_seed": cfg.run_seed,
+            "rounds_planned": cfg.rounds,
+            "round_budget": cfg.round_budget,
+            "init_pool_size": cfg.resolved_init_pool_size(),
+        }
+        run_t0 = time.monotonic()
 
         # The pipelined round coordinator (experiment/pipeline.py,
         # DESIGN.md §8): armed before each fit so the next query's pool
@@ -977,6 +1056,26 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
                 _emit_round_telemetry(telemetry, sink, rd, strategy,
                                       ladder,
                                       retries_baseline=run_retries0)
+                if write_report:
+                    row = {
+                        "round": rd,
+                        "labeled": int(strategy.pool.num_labeled),
+                        "cumulative_budget":
+                            float(strategy.pool.cumulative_cost),
+                        "test_accuracy": strategy.last_test_acc,
+                        "round_time_s": round(round_sp.duration_s, 3),
+                        "wall_clock_s": round(
+                            report_wall_base
+                            + (time.monotonic() - run_t0), 3),
+                        "phases_s": {k: round(v, 3)
+                                     for k, v in phase_s.items()},
+                    }
+                    diag = getattr(strategy, "diagnostics", None)
+                    if diag is not None:
+                        row.update(diag.last_row)
+                    report_rows.append(row)
+                    diag_lib.write_run_report(run_report_path,
+                                              report_header, report_rows)
                 if len(strategy.available_query_idxs(shuffle=False)) == 0:
                     logger.info("Finished querying all Images!")
                     break

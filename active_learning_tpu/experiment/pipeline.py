@@ -57,6 +57,7 @@ from .. import faults
 from ..strategies import scoring
 from ..telemetry import runtime as tele_runtime
 from ..telemetry import spans as tele_spans
+from ..telemetry import diagnostics as diag_lib
 from ..train import checkpoint as ckpt_lib
 from ..utils.logging import get_logger
 
@@ -400,6 +401,18 @@ class RoundPipeline:
                 score_s += time.perf_counter() - t0
                 inline += 1
         result = scoring.splice_chunks(outs)
+        # The experiment-truth layer's chunked histogram (DESIGN.md
+        # §13): per-chunk partials summed HERE, at consume — the merged
+        # sum is bit-equal to one add over the spliced result (integer
+        # bin counts; pinned in tests/test_diagnostics.py), so the
+        # strategy records the histogram without re-walking the scores.
+        diag = self._strategy.diagnostics
+        score_hist = None
+        if diag is not None and outs:
+            key = diag_lib.primary_score_key(outs[0])
+            if key is not None:
+                score_hist = {key: diag_lib.histogram_from_chunks(
+                    key, [c[key] for c in outs])}
         # Under the lock like every other stats mutation: the worker's
         # death harness can still increment chunks_failed concurrently
         # with this hand-over (found by the lock-discipline checker —
@@ -411,7 +424,8 @@ class RoundPipeline:
                              "inline": inline,
                              "hit_frac": round(hits / max(1, len(slices)),
                                                4),
-                             "score_s": score_s}
+                             "score_s": score_s,
+                             "score_hist": score_hist}
         self.logger.info(
             f"round pipeline: speculative scores served "
             f"{hits}/{len(slices)} chunks (inline-completed {inline})")

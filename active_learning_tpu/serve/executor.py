@@ -49,6 +49,7 @@ import numpy as np
 
 from ..parallel import mesh as mesh_lib
 from ..strategies import scoring
+from ..telemetry import diagnostics as diag_lib
 from ..train import checkpoint as ckpt_lib
 from ..utils.logging import get_logger
 
@@ -117,6 +118,15 @@ class DeviceExecutor:
         self.stats = {"batches": 0, "rows": 0, "reloads": 0,
                       "warm_buckets": []}
         self._compile_baseline: Optional[Dict[str, int]] = None
+        # Online score drift (telemetry/diagnostics.ServeScoreDrift,
+        # DESIGN.md §13): every served batch's margin folds into a live
+        # histogram; a hot reload snapshots it as the checkpoint-time
+        # baseline, and /metrics serves the live-vs-baseline PSI/JS —
+        # the per-model drift signal the streaming-AL loop (ROADMAP
+        # item 3) consumes.  Host-pure numpy over arrays the request
+        # path already fetched; its own lock (observe on this thread,
+        # snapshot on the server thread).
+        self.score_drift = diag_lib.ServeScoreDrift(key="margin")
 
     # -- checkpoint (re)loading ------------------------------------------
 
@@ -160,10 +170,14 @@ class DeviceExecutor:
         if now - self._last_reload_check < self.reload_every_s:
             return False
         self._last_reload_check = now
+        prev_round = self.served_round
         variables = self._load_latest()
         if variables is None:
             return False
         self._variables = mesh_lib.replicate(variables, self.mesh)
+        # What the OUTGOING checkpoint served becomes the drift
+        # baseline; the new model's scores accumulate against it.
+        self.score_drift.rebaseline(prev_round)
         with self._lock:
             self.stats["reloads"] += 1
         return True
@@ -281,6 +295,10 @@ class DeviceExecutor:
                     self.stats["rows"] += sum(e.n for e in entries)
                 for e in entries:
                     sl = slice(e.offset, e.offset + e.n)
+                    # Real rows only (the bucket's padding tail would
+                    # poison the distribution); the margin array is
+                    # already on host for the response.
+                    self.score_drift.observe(host["margin"][sl])
                     payload = {k: v[sl] for k, v in host.items()
                                if k != "embedding" or e.want_embed}
                     payload["round"] = self.served_round

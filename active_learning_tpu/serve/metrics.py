@@ -19,6 +19,7 @@ nil at any realistic qps).
 from __future__ import annotations
 
 import collections
+import math
 import threading
 import time
 from typing import Dict, Optional
@@ -135,4 +136,41 @@ def prometheus_samples(snap: Dict) -> list:
     for step, count in sorted((compiles.get("per_step") or {}).items()):
         samples.append(("al_serve_jit_cache_entries",
                         {"step": step}, count))
+    # The per-model acquisition-score histogram + live-vs-checkpoint
+    # drift (telemetry/diagnostics.ServeScoreDrift): the histogram is
+    # exposed Prometheus-style (cumulative buckets with ``le`` labels +
+    # _count/_sum), the drift gauges ride beside it — the online drift
+    # signal of DESIGN.md §13.
+    drift = snap.get("score_drift") or {}
+    live = drift.get("live") or {}
+    counts = live.get("counts") or []
+    if counts:
+        key = drift.get("key", "score")
+        lo, hi = live.get("lo", 0.0), live.get("hi", 1.0)
+        bins = max(1, int(live.get("bins", len(counts))))
+        log1p = live.get("transform") == "log1p"
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += int(c)
+            edge = lo + (i + 1) * (hi - lo) / bins
+            if log1p:
+                # The ladder is linear in TRANSFORMED space; `le`
+                # labels must be in score space or every scraper
+                # misreads the distribution.
+                edge = math.expm1(edge)
+            samples.append(("al_serve_score_hist_bucket",
+                            {"key": key, "le": f"{edge:.6g}"}, cum))
+        samples.append(("al_serve_score_hist_bucket",
+                        {"key": key, "le": "+Inf"}, cum))
+        samples.append(("al_serve_score_hist_count", {"key": key},
+                        live.get("n")))
+        samples.append(("al_serve_score_hist_sum", {"key": key},
+                        live.get("sum")))
+    if drift.get("baseline_round") is not None:
+        samples.append(("al_serve_score_baseline_round", None,
+                        drift.get("baseline_round")))
+    for metric in ("psi", "js"):
+        if drift.get(metric) is not None:
+            samples.append((f"al_serve_score_drift_{metric}", None,
+                            drift[metric]))
     return samples

@@ -339,6 +339,12 @@ class ScoringServer:
             # THE serving contract: 0 after warmup, forever.
             "request_path_compiles": self.executor.request_path_compiles(),
         }
+        # The per-model score histogram + live-vs-checkpoint drift
+        # (telemetry/diagnostics.ServeScoreDrift, DESIGN.md §13).
+        # getattr: stub executors (tests) carry no drift tracker.
+        drift = getattr(self.executor, "score_drift", None)
+        if drift is not None:
+            snap["score_drift"] = drift.snapshot()
         return snap
 
     def _metrics_prometheus(self) -> str:

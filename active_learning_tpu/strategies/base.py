@@ -38,6 +38,7 @@ from ..config import ExperimentConfig, TrainConfig
 from ..data.core import Dataset
 from ..pool import PoolState
 from ..registry import STRATEGIES
+from ..telemetry import diagnostics as diag_lib
 from ..train import checkpoint as ckpt_lib
 from ..train.trainer import Trainer, TrainState
 from ..utils.logging import get_logger
@@ -92,6 +93,22 @@ class Strategy:
         self.state: Optional[TrainState] = None
         self.best_epoch: int = 0
         self.best_perf: float = 0.0
+        # The last test() accuracy — the driver's run_report rows read
+        # it (test() already computes it; storing beats re-plumbing the
+        # return through the round loop).
+        self.last_test_acc: Optional[float] = None
+        # The experiment-truth diagnostics layer (telemetry/diagnostics,
+        # DESIGN.md §13): per-round score histograms + drift, selection
+        # composition, pick distances, calibration — all computed from
+        # host arrays that already exist.  None when disabled; every
+        # hot-path hook below is then a single None check (<2.5µs/call,
+        # pinned in tests/test_diagnostics.py), and picks/scores are
+        # bit-identical either way.
+        tele = getattr(cfg, "telemetry", None)
+        self.diagnostics = (
+            diag_lib.RoundDiagnostics(num_classes=self.num_classes)
+            if tele is not None and getattr(tele, "enabled", False)
+            and getattr(tele, "diagnostics", False) else None)
         # Device-resident pool cache: in-memory pool images live on device
         # for the WHOLE experiment (scoring.collect_pool fast path).  It
         # is the TRAINER'S cache, shared with evaluation, so one upload
@@ -218,6 +235,9 @@ class Strategy:
         """Mark queried examples labeled, spend budget, emit the audit
         trail (strategy.py:459-485)."""
         labeled_idxs = np.asarray(labeled_idxs, dtype=np.int64).reshape(-1)
+        # Selection composition (class balance / novelty) must read the
+        # labeled mask BEFORE this update flips it; one gated call.
+        self._record_pick_diagnostics(labeled_idxs)
         self.pool.update(labeled_idxs, cur_cost)
         self.sink.log_metric("cumulative_budget", self.pool.cumulative_cost,
                              step=self.round)
@@ -290,6 +310,10 @@ class Strategy:
         perf = self.trainer.evaluate(self.state, self.test_set,
                                      np.arange(len(self.test_set)))
         acc = float(perf["accuracy"])
+        self.last_test_acc = acc
+        # Calibration (ECE + confidence histogram) piggybacks on the
+        # eval pass's additive per-bin counts — no second pass.
+        self._record_calibration_diagnostics(perf)
         top5 = float(perf["top_5_accuracy"])
         byclass = np.asarray(perf["accuracy_byclass"])
         order = np.argsort(byclass)
@@ -381,6 +405,10 @@ class Strategy:
             out = self.pipeline.consume(kind, keys, np.asarray(idxs), bs,
                                         self.state.variables)
             if out is not None:
+                # Score histogram from the consume path's per-chunk
+                # partials (bit-equal to the monolithic add — pinned).
+                self._record_score_diagnostics(
+                    out, self.pipeline.last_consume.get("score_hist"))
                 if tele_runtime.get_run().train_metrics:
                     self.sink.log_metric(
                         "spec_hit_frac",
@@ -411,7 +439,54 @@ class Strategy:
         if tele_runtime.get_run().train_metrics and dt > 0:
             self.sink.log_metric("pool_rows_per_sec",
                                  round(len(idxs) / dt, 1), step=self.round)
+        self._record_score_diagnostics(out)
         return out
+
+    # -- experiment-truth diagnostics hooks (telemetry/diagnostics) -------
+    #
+    # Each hook is ONE flag check when diagnostics are off (the pinned
+    # <2.5µs/call off-path bound) and pure host-array math when on — the
+    # diagnostics-inert lint (scripts/al_lint.py) statically forbids
+    # anything heavier from growing here.
+
+    def _record_score_diagnostics(self, out: Dict[str, np.ndarray],
+                                  premerged=None) -> None:
+        """Fold a scoring pass's scalar acquisition scores into the
+        round's histogram.  ``premerged``: the pipelined consume path's
+        per-chunk partial sums ({key: ScoreHistogram}), used as-is."""
+        if self.diagnostics is None:
+            return
+        key = diag_lib.primary_score_key(out)
+        if key is None:
+            return
+        if premerged is not None and key in premerged:
+            self.diagnostics.observe_histogram(key, premerged[key])
+        else:
+            self.diagnostics.observe_scores(key, out[key])
+
+    def _record_pick_dist_diagnostics(self, dists) -> None:
+        """k-center pick distances, straight out of the selection scan
+        (strategies/kcenter.LAST_PICK_DISTS)."""
+        if self.diagnostics is None or dists is None:
+            return
+        self.diagnostics.observe_pick_dists(dists)
+
+    def _record_pick_diagnostics(self, labeled_idxs: np.ndarray) -> None:
+        """Selection composition for this round's picks (class balance
+        and novelty need oracle labels — simulated AL always has them)."""
+        if self.diagnostics is None or len(labeled_idxs) == 0:
+            return
+        targets = getattr(self.al_set, "targets", None)
+        if targets is not None:
+            targets = np.asarray(targets)[:len(self.al_set)]
+        self.diagnostics.observe_picks(labeled_idxs, targets,
+                                       self.pool.labeled_mask())
+
+    def _record_calibration_diagnostics(self, perf: Dict) -> None:
+        if self.diagnostics is None or "cal_count" not in perf:
+            return
+        self.diagnostics.observe_calibration(
+            perf["cal_count"], perf["cal_correct"], perf["cal_conf_sum"])
 
     def _resident_kwargs(self) -> Dict:
         """collect_pool kwargs for the device-resident pool: one gating

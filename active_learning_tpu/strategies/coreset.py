@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from . import kcenter as kcenter_lib
 from .base import Strategy, register_strategy
 from .kcenter import kcenter_greedy
 
@@ -146,6 +147,10 @@ class CoresetSampler(Strategy):
                                batch_q=self.cfg.kcenter_batch,
                                mesh=self.mesh,
                                pool_sharding=self.trainer.pool_sharding)
+        # Pick-time distance-to-labeled, captured from the selection
+        # scan's own values (telemetry/diagnostics, DESIGN.md §13) —
+        # one gated call, picks unaffected.
+        self._record_pick_dist_diagnostics(kcenter_lib.LAST_PICK_DISTS)
         selected = idxs_for_coreset[picks]
         assert len(np.unique(selected)) == len(selected), (
             "k-center selected a duplicate index")
@@ -237,6 +242,10 @@ class PartitionedCoresetSampler(CoresetSampler):
                                    batch_q=self.cfg.kcenter_batch,
                                    mesh=self.mesh,
                                    pool_sharding=self.trainer.pool_sharding)
+            # Per-partition pick distances accumulate into the same
+            # round diagnostics (each call refreshes the scan global).
+            self._record_pick_dist_diagnostics(
+                kcenter_lib.LAST_PICK_DISTS)
             selected.append(part[picks])
 
         selected = (np.sort(np.concatenate(selected)) if selected

@@ -86,6 +86,18 @@ LAST_BACKEND: Optional[str] = None
 # ("replicated" / "row") — the bench's pool_sharding attribution.
 LAST_SHARDING: Optional[str] = None
 
+# Each pick's squared distance-to-(labeled ∪ earlier picks) AT PICK
+# TIME, host float32 aligned with the last kcenter_greedy call's return
+# (NaN marks the once-per-experiment minimax/uniform seed, which has no
+# labeled set to be distant from).  The values already exist inside the
+# selection scans — the argmax/top-k maximum IS the pick's distance —
+# so riding them out beside the picks costs no extra pool pass, no
+# extra collective, and cannot perturb the pick sequence (pinned in
+# tests/test_diagnostics.py).  The experiment-truth layer
+# (telemetry/diagnostics.py) reads this for rd_pick_min_dist /
+# rd_pick_mean_dist and the k-center drift histogram.
+LAST_PICK_DISTS: Optional[np.ndarray] = None
+
 # Default q for the batched deterministic greedy: the f32 sublane tile
 # (8), the smallest batch that both cuts scan steps ~8x and fills an MXU
 # strip.  Overridden per experiment via ExperimentConfig.kcenter_batch.
@@ -187,20 +199,25 @@ def _kcenter_scan(factors: Factors, sqn: jnp.ndarray, min_dist: jnp.ndarray,
             total = jnp.sum(p)
             weights = jnp.where(total > 0, p, selectable)
             idx = jax.random.categorical(key, jnp.log(weights))
+            # The pick's distance diagnostic is the draw's own weight
+            # (clipped min-dist) — already materialized for the draw.
+            dval = p[idx]
         else:
             # The reference relies on picked rows having min_dist == 0 to
             # avoid re-selection; under float32 the incremental update can
             # leave a tiny positive residual on dense pools, so mask
             # explicitly — same selection, no duplicate risk.
-            idx = jnp.argmax(jnp.where(selectable > 0, min_dist, -jnp.inf))
+            masked = jnp.where(selectable > 0, min_dist, -jnp.inf)
+            idx = jnp.argmax(masked)
+            dval = masked[idx]
         d_new = sqn + sqn[idx] - 2.0 * dots_to(factors, idx)
         min_dist = jnp.minimum(min_dist, d_new)
         selectable = selectable.at[idx].set(0.0)
-        return (min_dist, selectable), idx
+        return (min_dist, selectable), (idx, dval)
 
     keys = jax.random.split(key, budget)
-    _, picks = jax.lax.scan(step, (min_dist, selectable), keys)
-    return picks
+    _, (picks, dists) = jax.lax.scan(step, (min_dist, selectable), keys)
+    return picks, dists
 
 
 def _recheck_candidates(cands: jnp.ndarray, vals: jnp.ndarray,
@@ -211,12 +228,16 @@ def _recheck_candidates(cands: jnp.ndarray, vals: jnp.ndarray,
     min-distances (descending, ties lowest-index first — matching
     argmax); ``d_cc`` is the [q, q] candidate pairwise distance table;
     ``limit`` caps accepted picks (budget remainder).  Returns
-    (order [q] of candidate POSITIONS in acceptance order, n_acc)."""
+    (order [q] of candidate POSITIONS in acceptance order, n_acc,
+    dvals [q] — each accepted pick's exact min-distance at acceptance,
+    in acceptance order; an accepted candidate dominates the whole pool
+    so this IS its distance-to-(labeled ∪ earlier picks), the number
+    the experiment-truth diagnostics ride out)."""
     q = cands.shape[0]
     thresh = vals[q - 1]
 
     def body(_, st):
-        cur, accepted, order, n_acc, last, stop = st
+        cur, accepted, order, dvals, n_acc, last, stop = st
         cur = jnp.minimum(cur, d_cc[:, last])
         avail = jnp.where(accepted, -jnp.inf, cur)
         m = jnp.max(avail)
@@ -229,15 +250,18 @@ def _recheck_candidates(cands: jnp.ndarray, vals: jnp.ndarray,
         accepted = accepted.at[p].set(accepted[p] | ok)
         order = jnp.where(ok, order.at[n_acc].set(p.astype(jnp.int32)),
                           order)
+        dvals = jnp.where(ok, dvals.at[n_acc].set(m), dvals)
         last = jnp.where(ok, p, last)
         n_acc = n_acc + ok.astype(jnp.int32)
-        return (cur, accepted, order, n_acc, last, stop | ~ok)
+        return (cur, accepted, order, dvals, n_acc, last, stop | ~ok)
 
     init = (vals, jnp.zeros(q, bool).at[0].set(True),
-            jnp.zeros(q, jnp.int32), jnp.int32(1), jnp.int32(0),
-            jnp.asarray(False))
-    _, _, order, n_acc, _, _ = jax.lax.fori_loop(0, q - 1, body, init)
-    return order, n_acc
+            jnp.zeros(q, jnp.int32),
+            jnp.zeros(q, vals.dtype).at[0].set(vals[0]), jnp.int32(1),
+            jnp.int32(0), jnp.asarray(False))
+    _, _, order, dvals, n_acc, _, _ = jax.lax.fori_loop(0, q - 1, body,
+                                                        init)
+    return order, n_acc, dvals
 
 
 def _accept_pick_batch(masked: jnp.ndarray, q: int, limit, sentinel: int,
@@ -248,13 +272,16 @@ def _accept_pick_batch(masked: jnp.ndarray, q: int, limit, sentinel: int,
     duplicates and the next step overwrites their pick slots).
     ``pair_dists(cands) -> [q, q]`` supplies the candidate pairwise
     squared distances in whichever factor layout the caller holds.
-    Returns (seq [q] pool indices, n_acc)."""
+    Returns (seq [q] pool indices, dseq [q] acceptance-time distances,
+    n_acc) — dseq slots past n_acc are dead exactly like seq's repeated
+    first pick (the next step overwrites their pick slots)."""
     vals, cands = jax.lax.top_k(masked, q)
-    order, n_acc = _recheck_candidates(cands, vals, pair_dists(cands),
-                                       limit, sentinel)
+    order, n_acc, dseq = _recheck_candidates(cands, vals,
+                                             pair_dists(cands), limit,
+                                             sentinel)
     slot = jnp.arange(q)
     seq = jnp.where(slot < n_acc, cands[order], cands[order[0]])
-    return seq, n_acc
+    return seq, dseq, n_acc
 
 
 @functools.partial(jax.jit, static_argnames=("budget", "q"),
@@ -270,29 +297,31 @@ def _kcenter_scan_batched(factors: Factors, sqn: jnp.ndarray,
     n = sqn.shape[0]
     # q trailing slots absorb the final step's padded writes; sliced off.
     picks0 = jnp.zeros(budget + q, jnp.int32)
+    dists0 = jnp.zeros(budget + q, min_dist.dtype)
 
     def cond(st):
-        return st[3] < budget
+        return st[4] < budget
 
     def pair_dists(cands):
         return (sqn[cands][:, None] + sqn[cands][None, :]
                 - 2.0 * dots_between(factors, cands))
 
     def body(st):
-        min_dist, selectable, picks, count = st
+        min_dist, selectable, picks, dists, count = st
         masked = jnp.where(selectable > 0, min_dist, -jnp.inf)
-        seq, n_acc = _accept_pick_batch(
+        seq, dseq, n_acc = _accept_pick_batch(
             masked, q, jnp.minimum(q, budget - count), n, pair_dists)
         min_dist = scoring.batched_min_dist_update(factors, sqn, min_dist,
                                                    seq)
         selectable = selectable.at[seq].set(0.0)
         picks = jax.lax.dynamic_update_slice(picks, seq.astype(jnp.int32),
                                              (count,))
-        return (min_dist, selectable, picks, count + n_acc)
+        dists = jax.lax.dynamic_update_slice(dists, dseq, (count,))
+        return (min_dist, selectable, picks, dists, count + n_acc)
 
-    _, _, picks, _ = jax.lax.while_loop(
-        cond, body, (min_dist, selectable, picks0, jnp.int32(0)))
-    return picks[:budget]
+    _, _, picks, dists, _ = jax.lax.while_loop(
+        cond, body, (min_dist, selectable, picks0, dists0, jnp.int32(0)))
+    return picks[:budget], dists[:budget]
 
 
 @functools.partial(jax.jit, static_argnames=("block",))
@@ -390,15 +419,18 @@ def _build_sharded_fns(mesh, nf: int):
         return taken, tsqn
 
     def _argmax_global(vals, n_total: int):
-        """Replicated global argmax index, ties to the LOWEST global
-        index — the full-vector argmax rule, via pmax + pmin."""
+        """Replicated global (argmax index, max value), ties to the
+        LOWEST global index — the full-vector argmax rule, via pmax +
+        pmin.  The max value rides out for free (it is the picked row's
+        min-distance, the diagnostics layer's number) — no extra
+        collective."""
         m_loc = jnp.max(vals)
         m = jax.lax.pmax(m_loc, axis)
         cand = jnp.where(m_loc >= m,
                          jnp.argmax(vals).astype(jnp.int32)
                          + _offset(vals.shape[0]),
                          jnp.int32(n_total))
-        return jax.lax.pmin(cand, axis)
+        return jax.lax.pmin(cand, axis), m
 
     def _topk_global(vals, q: int):
         """Replicated global (values, indices) top-q.  Local top_k per
@@ -477,9 +509,13 @@ def _build_sharded_fns(mesh, nf: int):
                 weights = jnp.where(total > 0, p_all, sel_all)
                 idx = jax.random.categorical(
                     key, jnp.log(weights)).astype(jnp.int32)
+                # The already-gathered weight vector holds the pick's
+                # clipped min-dist — the replicated scan's diagnostic,
+                # same bits, zero extra collectives.
+                dval = p_all[idx]
             else:
                 masked = jnp.where(selectable > 0, min_dist, -jnp.inf)
-                idx = _argmax_global(masked, n_total)
+                idx, dval = _argmax_global(masked, n_total)
             crows, csqn = _take(factors, sqn, idx[None])
             d = None
             for f, r in zip(factors, crows):
@@ -488,22 +524,24 @@ def _build_sharded_fns(mesh, nf: int):
             min_dist = jnp.minimum(min_dist, sqn + csqn[0] - 2.0 * d)
             selectable = selectable.at[_owned_or_oob(idx, sqn.shape[0])
                                        ].set(0.0, mode="drop")
-            return (min_dist, selectable), idx
+            return (min_dist, selectable), (idx, dval)
 
         keys = jax.random.split(key, budget)
-        _, picks = jax.lax.scan(step, (min_dist, selectable), keys)
-        return picks
+        _, (picks, dists) = jax.lax.scan(step, (min_dist, selectable),
+                                         keys)
+        return picks, dists
 
     def _scan_batched_body(factors, sqn, min_dist, selectable, budget: int,
                            q: int):
         n_total = sqn.shape[0] * ndev
         picks0 = jnp.zeros(budget + q, jnp.int32)
+        dists0 = jnp.zeros(budget + q, min_dist.dtype)
 
         def cond(st):
-            return st[3] < budget
+            return st[4] < budget
 
         def body(st):
-            min_dist, selectable, picks, count = st
+            min_dist, selectable, picks, dists, count = st
             masked = jnp.where(selectable > 0, min_dist, -jnp.inf)
             vals, cands = _topk_global(masked, q)
             crows, csqn = _take(factors, sqn, cands)
@@ -512,7 +550,7 @@ def _build_sharded_fns(mesh, nf: int):
                 dd = r @ r.T
                 d_cc = dd if d_cc is None else d_cc * dd
             d_cc = csqn[:, None] + csqn[None, :] - 2.0 * d_cc
-            order, n_acc = _recheck_candidates(
+            order, n_acc, dseq = _recheck_candidates(
                 cands, vals, d_cc, jnp.minimum(q, budget - count), n_total)
             slot = jnp.arange(q)
             seq = jnp.where(slot < n_acc, cands[order], cands[order[0]])
@@ -521,11 +559,13 @@ def _build_sharded_fns(mesh, nf: int):
             selectable = selectable.at[_owned_or_oob(seq, sqn.shape[0])
                                        ].set(0.0, mode="drop")
             picks = jax.lax.dynamic_update_slice(picks, seq, (count,))
-            return (min_dist, selectable, picks, count + n_acc)
+            dists = jax.lax.dynamic_update_slice(dists, dseq, (count,))
+            return (min_dist, selectable, picks, dists, count + n_acc)
 
-        _, _, picks, _ = jax.lax.while_loop(
-            cond, body, (min_dist, selectable, picks0, jnp.int32(0)))
-        return picks[:budget]
+        _, _, picks, dists, _ = jax.lax.while_loop(
+            cond, body, (min_dist, selectable, picks0, dists0,
+                         jnp.int32(0)))
+        return picks[:budget], dists[:budget]
 
     # No donate_argnums on the sharded jits: the would-be-donated
     # carries are the O(N) min-dist/selectable vectors (KBs-to-MBs,
@@ -536,7 +576,8 @@ def _build_sharded_fns(mesh, nf: int):
         return shard_map(
             lambda f, s, md, sel: _scan_batched_body(f, s, md, sel,
                                                      budget, q),
-            mesh=mesh, in_specs=(fspec, vec, vec, vec), out_specs=rep,
+            mesh=mesh, in_specs=(fspec, vec, vec, vec),
+            out_specs=(rep, rep),
             check_rep=False)(factors, sqn, min_dist, selectable)
 
     @functools.partial(jax.jit, static_argnames=("budget", "randomize"))
@@ -545,8 +586,8 @@ def _build_sharded_fns(mesh, nf: int):
             lambda f, s, md, sel, k: _scan_body(f, s, md, sel, k, budget,
                                                 randomize),
             mesh=mesh, in_specs=(fspec, vec, vec, vec, rep),
-            out_specs=rep, check_rep=False)(factors, sqn, min_dist,
-                                            selectable, key)
+            out_specs=(rep, rep), check_rep=False)(factors, sqn, min_dist,
+                                                   selectable, key)
 
     @jax.jit
     def min_chunk(factors, sqn, cfactors, min_dist):
@@ -570,6 +611,20 @@ def _build_sharded_fns(mesh, nf: int):
     return {"scan_batched": scan_batched, "scan_q1": scan_q1,
             "min_chunk": min_chunk, "minimax_block": minimax_block,
             "argmin_valid": argmin_valid}
+
+
+def _record_picks(picks: np.ndarray, dists, n_seed: int) -> np.ndarray:
+    """Publish the pick-distance diagnostics (LAST_PICK_DISTS) next to
+    the picks being returned: seed slots get NaN (no labeled set to be
+    distant from), the rest are the scan's pick-time min-distances.  The
+    dists fetch rides the SAME already-computed executable output the
+    picks fetch does — no extra pool pass, no effect on the picks."""
+    global LAST_PICK_DISTS
+    tail = (np.zeros(0, dtype=np.float32) if dists is None
+            else np.asarray(dists, dtype=np.float32))
+    LAST_PICK_DISTS = np.concatenate(
+        [np.full(n_seed, np.nan, dtype=np.float32), tail])
+    return picks
 
 
 def _sharded_jits(mesh, nf: int) -> Dict:
@@ -636,7 +691,8 @@ def _kcenter_greedy_sharded(factors_np: Tuple[np.ndarray, ...],
         labeled_idxs = np.asarray([seed_idx])
         budget -= 1
     if budget <= 0:
-        return np.asarray(picks_pre, dtype=np.int64)
+        return _record_picks(np.asarray(picks_pre, dtype=np.int64),
+                             None, len(picks_pre))
     q = max(1, min(q, budget))
 
     # Initial min pass: labeled chunks ride in as replicated host-sliced
@@ -659,16 +715,17 @@ def _kcenter_greedy_sharded(factors_np: Tuple[np.ndarray, ...],
 
     global LAST_BACKEND
     if q > 1:
-        picks = np.asarray(fns["scan_batched"](factors, sqn, min_dist,
-                                               sel_dev, budget, q),
-                           dtype=np.int64)
+        picks, dists = fns["scan_batched"](factors, sqn, min_dist,
+                                           sel_dev, budget, q)
         LAST_BACKEND = "xla-batched"
     else:
-        picks = np.asarray(fns["scan_q1"](factors, sqn, min_dist, sel_dev,
-                                          key, budget, bool(randomize)),
-                           dtype=np.int64)
+        picks, dists = fns["scan_q1"](factors, sqn, min_dist, sel_dev,
+                                      key, budget, bool(randomize))
         LAST_BACKEND = "xla"
-    return np.concatenate([np.asarray(picks_pre, dtype=np.int64), picks])
+    picks = np.asarray(picks, dtype=np.int64)
+    return _record_picks(
+        np.concatenate([np.asarray(picks_pre, dtype=np.int64), picks]),
+        dists, len(picks_pre))
 
 
 def row_capable(n: int, budget: int, mesh, batch_q: Optional[int] = None,
@@ -723,7 +780,7 @@ def kcenter_greedy(
     n = labeled_mask.shape[0]
     budget = int(budget)
     if budget <= 0:
-        return np.zeros(0, dtype=np.int64)
+        return _record_picks(np.zeros(0, dtype=np.int64), None, 0)
     if rng is None:
         rng = np.random.default_rng()
     key = jax.random.PRNGKey(int(rng.integers(2 ** 31)))
@@ -759,7 +816,8 @@ def kcenter_greedy(
         budget -= 1
 
     if budget <= 0:
-        return np.asarray(picks_pre, dtype=np.int64)
+        return _record_picks(np.asarray(picks_pre, dtype=np.int64),
+                             None, len(picks_pre))
 
     q = max(1, min(q, budget))
 
@@ -784,16 +842,17 @@ def kcenter_greedy(
     global LAST_BACKEND
     sel_dev = jnp.asarray(selectable)
     if q > 1:
-        picks = np.asarray(
-            _kcenter_scan_batched(factors, sqn, min_dist, sel_dev,
-                                  budget, q), dtype=np.int64)
+        picks, dists = _kcenter_scan_batched(factors, sqn, min_dist,
+                                             sel_dev, budget, q)
         LAST_BACKEND = "xla-batched"
     else:
-        picks = np.asarray(
-            _kcenter_scan(factors, sqn, min_dist, sel_dev, budget,
-                          bool(randomize), key), dtype=np.int64)
+        picks, dists = _kcenter_scan(factors, sqn, min_dist, sel_dev,
+                                     budget, bool(randomize), key)
         LAST_BACKEND = "xla"
-    return np.concatenate([np.asarray(picks_pre, dtype=np.int64), picks])
+    picks = np.asarray(picks, dtype=np.int64)
+    return _record_picks(
+        np.concatenate([np.asarray(picks_pre, dtype=np.int64), picks]),
+        dists, len(picks_pre))
 
 
 def adaptive_avg_pool_matrix(n_in: int, n_out: int) -> np.ndarray:

@@ -1,0 +1,567 @@
+"""The experiment-truth layer: acquisition diagnostics the AL loop
+itself emits (DESIGN.md §13).
+
+After host spans (§7) and device truth (§11), this is the third leg of
+the observability stack — what did the learner SELECT, is the score
+distribution drifting round over round, and how do two strategies
+compare at equal label budget.  Everything here is computed WHERE THE
+NUMBERS ALREADY EXIST: acquisition scores arrive on host as the normal
+output of every scoring pass, k-center pick distances ride out of the
+selection scans the picks already ride out of, and calibration counts
+piggyback on the eval batches — zero extra pool passes, zero extra
+device syncs, and picks bit-identical with diagnostics on or off
+(pinned in tests/test_diagnostics.py).
+
+This module is HOST-PURE by contract: numpy + stdlib only, no jax
+import, no device handles.  It consumes arrays that are already host
+arrays and produces floats, dicts, and JSON.  The contract is
+statically enforced — scripts/al_lint.py's ``diagnostics-inert`` check
+reads the ``_DIAGNOSTICS_HOST_PURE`` marker below and forbids jax
+imports and device-sync calls here, and forbids strategy/driver code
+from touching a ``.diagnostics`` attribute outside a flag-gated
+function — so the disabled path stays one None check per site and the
+enabled path can never add a hidden device round-trip to a strategy
+hot path.
+
+The histogram is the load-bearing structure: FIXED bin edges per score
+kind, so bin counts are pure sums — per-chunk partials from the
+speculative scorer merge at consume, per-shard partials from a
+row-sharded pool would psum, and two rounds' histograms compare without
+re-binning.  Merge order never changes a count (integer adds), so the
+chunked, sharded, and monolithic histograms are bit-equal (pinned).
+
+Honesty rules for the drift numbers (documented here because a drift
+metric that silently lies is worse than none):
+
+  * PSI and JS are only defined over histograms with IDENTICAL specs
+    (key/range/bins/transform) — a mismatch raises, never coerces.
+  * Fewer than ``MIN_DRIFT_N`` samples on either side returns None
+    (the gauge is dropped, not faked): tiny-round noise is not drift.
+  * PSI zero-bins are floored at ``PSI_EPS`` (the standard convention);
+    JS needs no smoothing (0·log 0 = 0) and is bounded by ln 2.
+  * Out-of-range mass clamps into the edge bins (it still counts and
+    still drifts); NaNs are dropped and counted in ``n_nan``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# The static host-purity marker scripts/al_lint.py's diagnostics-inert
+# check anchors on: this module may never import jax or call a device
+# sync (block_until_ready / device_get / device_put).
+_DIAGNOSTICS_HOST_PURE = True
+
+# Lock discipline (scripts/al_lint.py lock-discipline): ServeScoreDrift
+# is written by the serve executor thread and snapshotted by the asyncio
+# server thread — every touch of the live/baseline state holds _lock.
+_GUARDED_BY = {"_live": "_lock", "_baseline": "_lock",
+               "_baseline_round": "_lock"}
+
+# Fixed-bin specs per score kind: (lo, hi, bins, transform).  The
+# bounded scores use their natural range; unbounded non-negative scores
+# (MASE radii, k-center squared distances) bin on log1p so one fixed
+# ladder covers pixels-to-embedding scales without a data-dependent
+# range (which would break cross-round and cross-chunk mergeability).
+SCORE_SPECS: Dict[str, Tuple[float, float, int, str]] = {
+    "margin": (0.0, 1.0, 64, "none"),
+    "confidence": (0.0, 1.0, 64, "none"),
+    "entropy": (0.0, 8.0, 64, "none"),
+    "min_margin": (0.0, 32.0, 64, "log1p"),
+    "kcenter_dist": (0.0, 32.0, 64, "log1p"),
+}
+
+# The scalar acquisition score a scoring-pass output dict carries, in
+# priority order (min_margin beats margin: the MASE step emits both and
+# selects on min_margin).
+SCORE_KEY_PRIORITY = ("min_margin", "margin", "confidence", "entropy")
+
+# Below this many samples on either side, drift is None — not a number.
+MIN_DRIFT_N = 16
+# PSI zero-bin floor (the standard convention; JS needs none).
+PSI_EPS = 1e-4
+# Calibration bins for the eval-batch piggyback (train/evaluation.py
+# imports this so the device counts and the host ECE can never disagree
+# on the ladder).
+NUM_CAL_BINS = 10
+
+
+def primary_score_key(out: Dict[str, Any]) -> Optional[str]:
+    """The canonical scalar score key of a scoring-pass output dict, or
+    None when the pass carries no scalar score (embedding/factor
+    passes)."""
+    for key in SCORE_KEY_PRIORITY:
+        v = out.get(key)
+        if v is not None and getattr(v, "ndim", 0) == 1:
+            return key
+    return None
+
+
+class ScoreHistogram:
+    """A mergeable fixed-bin streaming histogram with exact summary
+    accumulators (n/sum/sumsq/min/max are computed on the RAW values, so
+    mean/std survive the binning).  Counts are int64 and bin edges are
+    fixed at construction: merging is pure integer addition, so chunked
+    / sharded / monolithic accumulation orders are bit-equal."""
+
+    __slots__ = ("key", "lo", "hi", "bins", "transform", "counts", "n",
+                 "n_nan", "vsum", "vsumsq", "vmin", "vmax")
+
+    def __init__(self, key: str, lo: float, hi: float, bins: int,
+                 transform: str = "none"):
+        if not hi > lo or bins < 2:
+            raise ValueError(f"bad histogram spec ({lo}, {hi}, {bins})")
+        if transform not in ("none", "log1p"):
+            raise ValueError(f"unknown transform {transform!r}")
+        self.key = key
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.bins = int(bins)
+        self.transform = transform
+        self.counts = np.zeros(self.bins, dtype=np.int64)
+        self.n = 0
+        self.n_nan = 0
+        self.vsum = 0.0
+        self.vsumsq = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    # -- spec / identity --------------------------------------------------
+
+    def spec(self) -> Tuple[str, float, float, int, str]:
+        return (self.key, self.lo, self.hi, self.bins, self.transform)
+
+    def same_spec(self, other: "ScoreHistogram") -> bool:
+        return self.spec() == other.spec()
+
+    # -- accumulation -----------------------------------------------------
+
+    def add(self, values) -> "ScoreHistogram":
+        """Fold host values in.  NaNs are dropped (and counted); mass
+        outside [lo, hi] clamps into the edge bins — it still counts and
+        still drifts, per the honesty rules."""
+        v = np.asarray(values, dtype=np.float64).ravel()
+        if v.size == 0:
+            return self
+        finite = np.isfinite(v)
+        self.n_nan += int(v.size - np.count_nonzero(finite))
+        v = v[finite]
+        if v.size == 0:
+            return self
+        self.n += int(v.size)
+        self.vsum += float(v.sum())
+        self.vsumsq += float(np.square(v).sum())
+        self.vmin = min(self.vmin, float(v.min()))
+        self.vmax = max(self.vmax, float(v.max()))
+        t = np.log1p(np.maximum(v, 0.0)) if self.transform == "log1p" \
+            else v
+        idx = np.floor((t - self.lo) / (self.hi - self.lo) * self.bins)
+        idx = np.clip(idx, 0, self.bins - 1).astype(np.int64)
+        self.counts += np.bincount(idx, minlength=self.bins
+                                   ).astype(np.int64)
+        return self
+
+    def merge(self, other: "ScoreHistogram") -> "ScoreHistogram":
+        """Integer-exact merge of a partial (per-chunk, per-shard) into
+        this one.  Specs must match — a silent re-bin would fabricate
+        drift."""
+        if not self.same_spec(other):
+            raise ValueError(
+                f"cannot merge histograms with different specs: "
+                f"{self.spec()} vs {other.spec()}")
+        self.counts += other.counts
+        self.n += other.n
+        self.n_nan += other.n_nan
+        self.vsum += other.vsum
+        self.vsumsq += other.vsumsq
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+        return self
+
+    # -- readout ----------------------------------------------------------
+
+    def fractions(self) -> np.ndarray:
+        total = int(self.counts.sum())
+        if total == 0:
+            return np.zeros(self.bins, dtype=np.float64)
+        return self.counts / float(total)
+
+    def edges(self) -> np.ndarray:
+        """Upper bin edges in TRANSFORMED space ([lo, hi] ladder)."""
+        return self.lo + (np.arange(1, self.bins + 1)
+                          * (self.hi - self.lo) / self.bins)
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        if self.n == 0:
+            return {"n": 0, "mean": None, "std": None, "min": None,
+                    "max": None}
+        mean = self.vsum / self.n
+        var = max(0.0, self.vsumsq / self.n - mean * mean)
+        return {"n": self.n, "mean": round(mean, 6),
+                "std": round(math.sqrt(var), 6),
+                "min": round(self.vmin, 6), "max": round(self.vmax, 6)}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"key": self.key, "lo": self.lo, "hi": self.hi,
+                "bins": self.bins, "transform": self.transform,
+                "counts": self.counts.tolist(), "n": self.n,
+                "n_nan": self.n_nan, "sum": self.vsum,
+                "sumsq": self.vsumsq,
+                "min": None if self.n == 0 else self.vmin,
+                "max": None if self.n == 0 else self.vmax}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ScoreHistogram":
+        h = cls(d["key"], d["lo"], d["hi"], d["bins"],
+                d.get("transform", "none"))
+        h.counts = np.asarray(d["counts"], dtype=np.int64)
+        h.n = int(d["n"])
+        h.n_nan = int(d.get("n_nan", 0))
+        h.vsum = float(d.get("sum", 0.0))
+        h.vsumsq = float(d.get("sumsq", 0.0))
+        h.vmin = math.inf if d.get("min") is None else float(d["min"])
+        h.vmax = -math.inf if d.get("max") is None else float(d["max"])
+        return h
+
+
+def histogram_for(key: str) -> ScoreHistogram:
+    """An empty histogram with the canonical spec for a score kind
+    (unknown kinds get the log1p ladder — safe for any non-negative
+    score)."""
+    lo, hi, bins, transform = SCORE_SPECS.get(key, (0.0, 32.0, 64,
+                                                   "log1p"))
+    return ScoreHistogram(key, lo, hi, bins, transform)
+
+
+def histogram_from_chunks(key: str, chunks: Sequence) -> ScoreHistogram:
+    """Per-chunk partials summed — exactly the accumulation the
+    speculative scorer's consume path performs (bit-equal to one add
+    over the concatenation; pinned in tests/test_diagnostics.py)."""
+    hist = histogram_for(key)
+    for c in chunks:
+        if isinstance(c, ScoreHistogram):
+            hist.merge(c)
+        else:
+            hist.add(c)
+    return hist
+
+
+# -- drift -------------------------------------------------------------------
+
+def _check_comparable(a: ScoreHistogram, b: ScoreHistogram
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    if not a.same_spec(b):
+        raise ValueError(
+            f"drift between different histogram specs is undefined: "
+            f"{a.spec()} vs {b.spec()}")
+    if a.n < MIN_DRIFT_N or b.n < MIN_DRIFT_N:
+        return None
+    return a.fractions(), b.fractions()
+
+
+def psi(cur: ScoreHistogram, ref: ScoreHistogram) -> Optional[float]:
+    """Population Stability Index of ``cur`` against ``ref``: sum over
+    bins of (p - q)·ln(p/q), zero-bins floored at PSI_EPS.  None below
+    MIN_DRIFT_N on either side.  Rule of thumb: < 0.1 stable, 0.1-0.25
+    shifting, > 0.25 a different population."""
+    fracs = _check_comparable(cur, ref)
+    if fracs is None:
+        return None
+    p = np.maximum(fracs[0], PSI_EPS)
+    q = np.maximum(fracs[1], PSI_EPS)
+    return float(np.sum((p - q) * np.log(p / q)))
+
+
+def js_divergence(cur: ScoreHistogram, ref: ScoreHistogram
+                  ) -> Optional[float]:
+    """Jensen–Shannon divergence (nats, bounded by ln 2) — the
+    symmetric, smoothing-free companion to PSI (0·log 0 = 0 is exact,
+    so no epsilon enters the number)."""
+    fracs = _check_comparable(cur, ref)
+    if fracs is None:
+        return None
+    p, q = fracs
+    m = 0.5 * (p + q)
+
+    def _kl(a: np.ndarray) -> float:
+        nz = a > 0
+        return float(np.sum(a[nz] * np.log(a[nz] / m[nz])))
+
+    return 0.5 * _kl(p) + 0.5 * _kl(q)
+
+
+# -- calibration -------------------------------------------------------------
+
+def ece_from_counts(cal_count, cal_correct, cal_conf_sum
+                    ) -> Optional[float]:
+    """Expected Calibration Error from the additive per-bin counts the
+    eval step emits (train/evaluation.batch_metric_counts):
+    sum_b (n_b/N)·|acc_b − conf_b| over populated bins.  None on an
+    empty eval set."""
+    count = np.asarray(cal_count, dtype=np.float64)
+    correct = np.asarray(cal_correct, dtype=np.float64)
+    conf = np.asarray(cal_conf_sum, dtype=np.float64)
+    n = float(count.sum())
+    if n <= 0:
+        return None
+    nz = count > 0
+    gap = np.abs(correct[nz] / count[nz] - conf[nz] / count[nz])
+    return float(np.sum(count[nz] / n * gap))
+
+
+# -- selection composition ---------------------------------------------------
+
+def pick_composition(picks: np.ndarray, targets: Optional[np.ndarray],
+                     labeled_mask_before: Optional[np.ndarray],
+                     num_classes: int) -> Dict[str, Optional[float]]:
+    """Class balance + novelty of one round's picks, from oracle labels
+    where the protocol has them (simulated AL always does; None fields
+    otherwise):
+
+      class_balance  normalized entropy of the picks' class histogram
+                     (1.0 = uniform over classes, 0.0 = one class);
+      novelty        fraction of picks whose class had NO labeled
+                     example before this round's update.
+    """
+    n = int(len(picks))
+    out: Dict[str, Optional[float]] = {"n_picks": n, "class_balance": None,
+                                       "novelty": None}
+    if n == 0 or targets is None or num_classes < 2:
+        return out
+    targets = np.asarray(targets)
+    pick_classes = targets[np.asarray(picks, dtype=np.int64)]
+    hist = np.bincount(pick_classes, minlength=num_classes
+                       ).astype(np.float64)
+    fracs = hist / n
+    nzf = fracs[fracs > 0]
+    ent = float(-np.sum(nzf * np.log(nzf)))
+    out["class_balance"] = round(ent / math.log(num_classes), 6)
+    if labeled_mask_before is not None:
+        seen = np.bincount(targets[np.asarray(labeled_mask_before,
+                                              dtype=bool)],
+                           minlength=num_classes) > 0
+        out["novelty"] = round(float(np.mean(~seen[pick_classes])), 6)
+    return out
+
+
+# -- the per-round accumulator -----------------------------------------------
+
+class RoundDiagnostics:
+    """One experiment's acquisition-diagnostics state: the current
+    round's accumulators, the previous scored round's histograms (the
+    drift reference), and the last finished round's report row.
+
+    Driven single-threaded from the strategy/driver round loop;
+    everything it consumes is already a host array.  ``reset_round``
+    clears the current round only (the degradation ladder's rollback
+    path — the previous round's reference must survive a retried
+    attempt)."""
+
+    def __init__(self, num_classes: int = 0):
+        self.num_classes = int(num_classes)
+        self._cur: Dict[str, ScoreHistogram] = {}
+        self._prev: Dict[str, ScoreHistogram] = {}
+        self._composition: Optional[Dict[str, Optional[float]]] = None
+        self._pick_dists: List[np.ndarray] = []
+        self._ece: Optional[float] = None
+        self._cal_hist: Optional[List[float]] = None
+        self.last_row: Dict[str, Any] = {}
+
+    # -- observations (all host arrays, all cheap) ------------------------
+
+    def observe_scores(self, key: str, values) -> None:
+        self._cur.setdefault(key, histogram_for(key)).add(values)
+
+    def observe_histogram(self, key: str, hist: ScoreHistogram) -> None:
+        """A pre-merged partial (the speculative consume path hands the
+        per-chunk sum straight over)."""
+        self._cur.setdefault(key, histogram_for(key)).merge(hist)
+
+    def observe_picks(self, picks, targets, labeled_mask_before) -> None:
+        self._composition = pick_composition(
+            np.asarray(picks, dtype=np.int64), targets,
+            labeled_mask_before, self.num_classes)
+
+    def observe_pick_dists(self, dists) -> None:
+        """k-center pick distances (distance-to-labeled at pick time,
+        straight out of the selection scan; NaN marks the seed pick).
+        They double as the k-center family's drift signal."""
+        d = np.asarray(dists, dtype=np.float64).ravel()
+        if d.size == 0:
+            return
+        self._pick_dists.append(d)
+        self.observe_scores("kcenter_dist", d)
+
+    def observe_calibration(self, cal_count, cal_correct,
+                            cal_conf_sum) -> None:
+        self._ece = ece_from_counts(cal_count, cal_correct, cal_conf_sum)
+        self._cal_hist = [int(c) for c in np.asarray(cal_count).tolist()]
+
+    # -- round boundary ---------------------------------------------------
+
+    def reset_round(self) -> None:
+        """Drop the CURRENT round's accumulators (a failed round attempt
+        rolls back and replays; its partial observations must not
+        double-count).  The previous round's drift reference survives."""
+        self._cur = {}
+        self._composition = None
+        self._pick_dists = []
+        self._ece = None
+        self._cal_hist = None
+
+    def finish_round(self, rd: int) -> Dict[str, Optional[float]]:
+        """Close the round: drift vs the previous scored round on the
+        primary score histogram, score summary stats, composition, pick
+        distances, calibration — as the flat gauge dict the driver
+        pushes through BOTH metric channels.  Rolls the current
+        histograms into the drift reference (a round that scored
+        nothing, e.g. a seeded round 0, leaves the reference alone, so
+        drift always compares consecutive SCORED rounds)."""
+        gauges: Dict[str, Optional[float]] = {}
+        key = next((k for k in (*SCORE_KEY_PRIORITY, "kcenter_dist")
+                    if k in self._cur), None)
+        if key is not None:
+            cur = self._cur[key]
+            s = cur.summary()
+            gauges["rd_score_mean"] = s["mean"]
+            gauges["rd_score_std"] = s["std"]
+            ref = self._prev.get(key)
+            if ref is not None:
+                p = psi(cur, ref)
+                j = js_divergence(cur, ref)
+                gauges["rd_score_drift_psi"] = (None if p is None
+                                                else round(p, 6))
+                gauges["rd_score_drift_js"] = (None if j is None
+                                               else round(j, 6))
+        comp = self._composition
+        if comp is not None:
+            gauges["rd_pick_class_balance"] = comp["class_balance"]
+            gauges["rd_pick_novelty"] = comp["novelty"]
+        if self._pick_dists:
+            d = np.concatenate(self._pick_dists)
+            if np.isfinite(d).any():
+                gauges["rd_pick_min_dist"] = round(float(np.nanmin(d)), 6)
+                gauges["rd_pick_mean_dist"] = round(float(np.nanmean(d)),
+                                                    6)
+        if self._ece is not None:
+            gauges["rd_ece"] = round(self._ece, 6)
+        self.last_row = {
+            "score_key": key,
+            "score": (self._cur[key].summary() if key is not None
+                      else None),
+            "drift": {"psi": gauges.get("rd_score_drift_psi"),
+                      "js": gauges.get("rd_score_drift_js")},
+            "composition": comp,
+            "pick_dist": {"min": gauges.get("rd_pick_min_dist"),
+                          "mean": gauges.get("rd_pick_mean_dist")},
+            "calibration": {"ece": gauges.get("rd_ece"),
+                            "conf_hist": self._cal_hist},
+        }
+        if self._cur:
+            self._prev = self._cur
+        self.reset_round()
+        return gauges
+
+
+# -- serve-side drift --------------------------------------------------------
+
+class ServeScoreDrift:
+    """The same histogram/drift machinery, online: the executor folds
+    each served batch's acquisition scores into a live histogram; when a
+    new checkpoint hot-reloads, the accumulated histogram becomes the
+    checkpoint-time BASELINE and a fresh live one starts — the drift
+    gauge on /metrics then reads the current model's score distribution
+    against the distribution the previous checkpoint served (the online
+    drift signal ROADMAP item 3's streaming loop consumes).
+
+    Thread contract: ``observe``/``rebaseline`` run on the executor
+    thread, ``snapshot`` on the asyncio server thread — all state under
+    ``_lock`` (see _GUARDED_BY)."""
+
+    def __init__(self, key: str = "margin"):
+        self.key = key
+        self._lock = threading.Lock()
+        self._live = histogram_for(key)
+        self._baseline: Optional[ScoreHistogram] = None
+        self._baseline_round: Optional[int] = None
+
+    def observe(self, values) -> None:
+        with self._lock:
+            self._live.add(values)
+
+    def rebaseline(self, served_round: Optional[int]) -> None:
+        """A new checkpoint took over: what the previous one served is
+        now the reference distribution."""
+        with self._lock:
+            if self._live.n > 0:
+                self._baseline = self._live
+                self._baseline_round = served_round
+            self._live = histogram_for(self.key)
+
+    def snapshot(self) -> Dict[str, Any]:
+        # Everything — the dict serialization AND the drift math — runs
+        # under the lock: the executor thread's observe() mutates the
+        # live histogram's n/counts non-atomically, so reading them
+        # outside the lock could expose a count/bucket mismatch or a
+        # PSI over half-updated bins to a scrape.  All cheap numpy over
+        # a 64-bin vector; contention is nil.
+        with self._lock:
+            live, base = self._live, self._baseline
+            out: Dict[str, Any] = {
+                "key": self.key, "live": live.to_dict(),
+                "baseline_round": self._baseline_round,
+                "psi": None, "js": None,
+            }
+            if base is not None:
+                p = psi(live, base)
+                j = js_divergence(live, base)
+                out["psi"] = None if p is None else round(p, 6)
+                out["js"] = None if j is None else round(j, 6)
+        return out
+
+
+# -- the per-run report artifact ---------------------------------------------
+
+RUN_REPORT_FILE = "run_report.json"
+
+
+def write_run_report(path: str, header: Dict[str, Any],
+                     rows: List[Dict[str, Any]]) -> bool:
+    """Atomically persist the per-run report (the label-efficiency curve
+    plus this layer's per-round diagnostics).  Never raises — a full
+    disk must not kill the run (same contract as the Prometheus scrape
+    file)."""
+    payload = {"schema": 1, **header, "rounds": rows}
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=1, default=_json_default)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False
+
+
+def read_run_report(path: str) -> Optional[Dict[str, Any]]:
+    """The persisted report, or None when absent/unparseable (resume
+    merges prior rounds' rows through this)."""
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def _json_default(o: Any):
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, np.generic):
+        return o.item()
+    return str(o)

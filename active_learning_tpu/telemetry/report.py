@@ -1,0 +1,322 @@
+"""The ``report`` CLI verb: render a run's label-efficiency curve, or a
+cross-run strategy comparison at matched label budgets — the paper's
+headline figure (accuracy per strategy per budget) as a machine-
+generated artifact.
+
+    python -m active_learning_tpu report <log_dir>
+    python -m active_learning_tpu report <log_dir_a> <log_dir_b> ...
+    python scripts/run_report.py --selftest
+
+Reads what the driver writes anyway: ``run_report.json`` (the per-round
+rows the round loop atomically rewrites — experiment/driver.py,
+DESIGN.md §13), falling back to reconstructing the curve from
+``metrics.jsonl`` for experiment dirs that predate the report artifact.
+Same contract as the ``status`` verb: stdlib only, no jax import,
+answers in milliseconds from any shell.
+
+Comparison mode tabulates N experiment dirs at MATCHED budgets: a row
+per cumulative label budget every run reached, a column per run, best
+accuracy starred.  Runs whose budget grids never intersect fall back to
+the union grid with blanks — stated in the output, never silently
+interpolated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+RUN_REPORT_FILE = "run_report.json"
+
+# The per-round columns of the single-run table: (header, row -> cell).
+_COLUMNS = (
+    ("round", lambda r: r.get("round")),
+    ("labeled", lambda r: r.get("labeled")),
+    ("budget", lambda r: _int_or_none(r.get("cumulative_budget"))),
+    ("accuracy", lambda r: _fmt(r.get("test_accuracy"), 4)),
+    ("round_s", lambda r: _fmt(r.get("round_time_s"), 1)),
+    ("wall_s", lambda r: _fmt(r.get("wall_clock_s"), 1)),
+    ("drift_psi", lambda r: _fmt((r.get("drift") or {}).get("psi"), 4)),
+    ("drift_js", lambda r: _fmt((r.get("drift") or {}).get("js"), 4)),
+    ("balance", lambda r: _fmt((r.get("composition") or {})
+                               .get("class_balance"), 3)),
+    ("novelty", lambda r: _fmt((r.get("composition") or {})
+                               .get("novelty"), 3)),
+    ("ece", lambda r: _fmt((r.get("calibration") or {}).get("ece"), 4)),
+)
+
+
+def _fmt(v: Any, digits: int) -> Optional[str]:
+    if v is None:
+        return None
+    try:
+        return f"{float(v):.{digits}f}"
+    except (TypeError, ValueError):
+        return None
+
+
+def _int_or_none(v: Any) -> Optional[int]:
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _report_path(path: str) -> str:
+    return path if path.endswith(".json") else os.path.join(
+        path, RUN_REPORT_FILE)
+
+
+def _rows_from_metrics_jsonl(log_dir: str) -> List[Dict[str, Any]]:
+    """Reconstruct the label-efficiency rows from metrics.jsonl — the
+    fallback for experiment dirs older than the run_report artifact.
+    Scans the WHOLE file (this is an offline reporting tool, not the
+    status tail)."""
+    path = os.path.join(log_dir, "metrics.jsonl")
+    per_round: Dict[int, Dict[str, Any]] = {}
+    wanted = {"rd_test_accuracy": "test_accuracy",
+              "cumulative_budget": "cumulative_budget",
+              "rd_round_time": "round_time_s",
+              "rd_score_drift_psi": ("drift", "psi"),
+              "rd_score_drift_js": ("drift", "js"),
+              "rd_pick_class_balance": ("composition", "class_balance"),
+              "rd_pick_novelty": ("composition", "novelty"),
+              "rd_ece": ("calibration", "ece")}
+    # The rotated predecessor first, so the live file's rows win.
+    for name in ("metrics.jsonl.1", "metrics.jsonl"):
+        try:
+            fh = open(os.path.join(log_dir, name))
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(ev, dict) or ev.get("kind") != "metric":
+                    continue
+                step = ev.get("step")
+                if not isinstance(step, (int, float)) or step < 0:
+                    continue
+                rd = int(step)
+                for name_, dest in wanted.items():
+                    if name_ not in (ev.get("metrics") or {}):
+                        continue
+                    row = per_round.setdefault(rd, {"round": rd})
+                    value = ev["metrics"][name_]
+                    if isinstance(dest, tuple):
+                        row.setdefault(dest[0], {})[dest[1]] = value
+                    else:
+                        row[dest] = value
+    return [per_round[rd] for rd in sorted(per_round)
+            if "test_accuracy" in per_round[rd]
+            or "cumulative_budget" in per_round[rd]]
+
+
+def load_run(path: str) -> Optional[Dict[str, Any]]:
+    """One experiment's report payload from a log dir (or a direct
+    run_report.json path), with the metrics.jsonl fallback.  None when
+    the dir holds neither."""
+    report_path = _report_path(path)
+    payload = None
+    try:
+        with open(report_path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        payload = None
+    if isinstance(payload, dict) and payload.get("rounds"):
+        payload.setdefault("source", report_path)
+        return payload
+    log_dir = path if os.path.isdir(path) else os.path.dirname(path)
+    rows = _rows_from_metrics_jsonl(log_dir)
+    if not rows:
+        return None
+    return {"schema": 0, "exp_name": os.path.basename(
+                os.path.normpath(log_dir)),
+            "strategy": None, "rounds": rows,
+            "source": os.path.join(log_dir, "metrics.jsonl")}
+
+
+def run_label(run: Dict[str, Any]) -> str:
+    name = run.get("exp_name") or "run"
+    strategy = run.get("strategy")
+    return f"{name}[{strategy}]" if strategy else str(name)
+
+
+def _table(headers: List[str], rows: List[List[Optional[str]]]) -> str:
+    cells = [[("-" if c is None else str(c)) for c in row]
+             for row in rows]
+    widths = [max(len(h), *(len(r[i]) for r in cells)) if cells
+              else len(h) for i, h in enumerate(headers)]
+    lines = ["  ".join(h.rjust(w) for h, w in zip(headers, widths))]
+    for row in cells:
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+    return "\n".join(lines)
+
+
+def render_single(run: Dict[str, Any]) -> str:
+    rows = [[fn(r) for _, fn in _COLUMNS] for r in run["rounds"]]
+    head = (f"run report: {run_label(run)}  "
+            f"(dataset={run.get('dataset')}, seed={run.get('run_seed')}, "
+            f"source={run.get('source')})")
+    return head + "\n" + _table([h for h, _ in _COLUMNS], rows)
+
+
+def accuracy_by_budget(run: Dict[str, Any]) -> Dict[int, float]:
+    """{cumulative budget: test accuracy} over the run's rounds (the
+    label-efficiency curve's support points; rounds without a test
+    accuracy are skipped)."""
+    out: Dict[int, float] = {}
+    for r in run.get("rounds", []):
+        budget = _int_or_none(r.get("cumulative_budget"))
+        acc = r.get("test_accuracy")
+        if budget is not None and isinstance(acc, (int, float)):
+            out[budget] = float(acc)
+    return out
+
+
+def render_compare(runs: List[Dict[str, Any]],
+                   budgets: Optional[List[int]] = None) -> str:
+    """The strategy-comparison table at matched budgets: one row per
+    budget, one column per run, best accuracy starred."""
+    curves = [accuracy_by_budget(r) for r in runs]
+    labels = [run_label(r) for r in runs]
+    if budgets:
+        grid = sorted(budgets)
+        note = "requested budgets"
+    else:
+        common = set(curves[0]) if curves else set()
+        for c in curves[1:]:
+            common &= set(c)
+        if common:
+            grid = sorted(common)
+            note = "budgets matched across all runs"
+        else:
+            grid = sorted(set().union(*curves)) if curves else []
+            note = ("no common budget grid — union shown, blanks where "
+                    "a run never reached that budget")
+    rows = []
+    for b in grid:
+        accs = [c.get(b) for c in curves]
+        best = max((a for a in accs if a is not None), default=None)
+        cells: List[Optional[str]] = [b]
+        for a in accs:
+            if a is None:
+                cells.append(None)
+            else:
+                star = " *" if best is not None and a == best else ""
+                cells.append(f"{a:.4f}{star}")
+        rows.append(cells)
+    head = (f"strategy comparison at matched label budgets "
+            f"({note}; * best at that budget)")
+    return head + "\n" + _table(["budget"] + labels, rows)
+
+
+def compare_payload(runs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    return {"runs": [{"label": run_label(r), "source": r.get("source"),
+                      "curve": accuracy_by_budget(r)} for r in runs]}
+
+
+# -- selftest ----------------------------------------------------------------
+
+def _selftest() -> int:
+    """Build two synthetic experiment dirs, render both modes, assert
+    the artifacts say what they must — the preflight gate's last link
+    (scripts/preflight.sh)."""
+    import tempfile
+
+    def fake_run(root: str, name: str, strategy: str,
+                 accs: List[float]) -> str:
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        rows = [{"round": i, "labeled": 16 * (i + 1),
+                 "cumulative_budget": 16 * (i + 1),
+                 "test_accuracy": a, "round_time_s": 1.0 + i,
+                 "wall_clock_s": 2.0 * (i + 1),
+                 "drift": {"psi": 0.01 * i if i else None,
+                           "js": 0.005 * i if i else None}}
+                for i, a in enumerate(accs)]
+        with open(os.path.join(d, RUN_REPORT_FILE), "w") as fh:
+            json.dump({"schema": 1, "exp_name": name,
+                       "strategy": strategy, "rounds": rows}, fh)
+        return d
+
+    with tempfile.TemporaryDirectory() as root:
+        a = fake_run(root, "margin_run", "MarginSampler",
+                     [0.30, 0.52, 0.61])
+        b = fake_run(root, "coreset_run", "CoresetSampler",
+                     [0.28, 0.55, 0.60])
+        ra, rb = load_run(a), load_run(b)
+        assert ra is not None and rb is not None
+        single = render_single(ra)
+        assert "margin_run[MarginSampler]" in single
+        assert "0.5200" in single and "drift_psi" in single
+        table = render_compare([ra, rb])
+        assert "matched" in table
+        assert "0.5500 *" in table, table  # coreset wins at budget 32
+        assert "0.6100 *" in table, table  # margin wins at budget 48
+        # A dir with neither artifact is a None, not a crash.
+        empty = os.path.join(root, "empty")
+        os.makedirs(empty)
+        assert load_run(empty) is None
+    print("run_report selftest: ok")
+    return 0
+
+
+def get_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m active_learning_tpu report",
+        description="Render per-run label-efficiency reports and "
+                    "cross-run strategy comparisons at matched budgets")
+    p.add_argument("dirs", nargs="*",
+                   help="experiment log dirs (holding run_report.json "
+                        "or metrics.jsonl); one = the run's curve, "
+                        "several = the comparison table")
+    p.add_argument("--budgets", type=str, default=None,
+                   help="comma-separated budgets to compare at "
+                        "(default: every budget all runs reached)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--selftest", action="store_true",
+                   help="self-contained smoke over synthetic runs "
+                        "(the preflight gate's last link); exits 0/1")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = get_parser().parse_args(argv)
+    if args.selftest:
+        try:
+            return _selftest()
+        except AssertionError as exc:
+            print(f"run_report selftest FAILED: {exc}")
+            return 1
+    if not args.dirs:
+        get_parser().print_usage()
+        return 2
+    runs = []
+    for d in args.dirs:
+        run = load_run(d)
+        if run is None:
+            print(f"report: no run_report.json or metrics.jsonl "
+                  f"under {d!r}")
+            return 2
+        runs.append(run)
+    if args.as_json:
+        payload = (runs[0] if len(runs) == 1 else compare_payload(runs))
+        print(json.dumps(payload, indent=1))
+        return 0
+    if len(runs) == 1:
+        print(render_single(runs[0]))
+        return 0
+    budgets = ([int(b) for b in args.budgets.split(",") if b.strip()]
+               if args.budgets else None)
+    print(render_compare(runs, budgets=budgets))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

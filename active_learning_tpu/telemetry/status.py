@@ -138,6 +138,8 @@ def summarize(log_dir: str, stale_after: Optional[float] = None,
         "fault_retries_total", "degrade_events",
         "rd_query_time", "rd_train_time", "rd_test_time",
         "overlap_frac", "round_vs_max_phase", "spec_hit_frac",
+        "rd_score_drift_psi", "rd_score_drift_js", "rd_score_mean",
+        "rd_pick_class_balance", "rd_pick_novelty", "rd_ece",
     ])
     state = ("no-heartbeat" if not heartbeats
              else "stale" if any_stale else "ok")
@@ -202,6 +204,22 @@ def render_text(summary: Dict[str, Any]) -> str:
                 step = f" @step {e['step']}" if e.get("step") is not None \
                     else ""
                 lines.append(f"    {name:>22} = {e['value']}{step}")
+        # The drift tail (telemetry/diagnostics.py, DESIGN.md §13),
+        # next to the pipeline-health tail: the latest score-drift /
+        # composition / calibration readings, so a shell glance shows
+        # whether the acquisition distribution is moving — not just
+        # whether the machinery is.
+        drift_names = ("rd_score_drift_psi", "rd_score_drift_js",
+                       "rd_score_mean", "rd_pick_class_balance",
+                       "rd_pick_novelty", "rd_ece")
+        if any(name in m for name in drift_names):
+            lines.append("  drift / acquisition:")
+            for name in drift_names:
+                if name in m:
+                    e = m[name]
+                    step = (f" @step {e['step']}"
+                            if e.get("step") is not None else "")
+                    lines.append(f"    {name:>22} = {e['value']}{step}")
     else:
         lines.append("  (no metrics.jsonl events found)")
     return "\n".join(lines)

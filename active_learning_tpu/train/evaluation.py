@@ -20,25 +20,45 @@ import numpy as np
 
 from ..data.augment import apply_view
 from ..data.core import ViewSpec
+# The calibration bin count is owned by the host-pure diagnostics layer
+# (telemetry/diagnostics.py) so the device counts here and the host ECE
+# there can never disagree on the ladder.
+from ..telemetry.diagnostics import NUM_CAL_BINS
 
 
 def batch_metric_counts(logits: jnp.ndarray, labels: jnp.ndarray,
                         mask: jnp.ndarray, num_classes: int,
                         top_k: int = 5) -> Dict[str, jnp.ndarray]:
     """Counts for one batch: top-1/top-k corrects, per-class corrects and
-    totals.  Padding rows (mask 0) contribute nothing."""
+    totals, plus the calibration bins (per-confidence-bin count /
+    correct / confidence-sum — additive, so they merge across batches,
+    chunks, and shards exactly like the accuracy counts; the host side
+    derives ECE in telemetry/diagnostics.ece_from_counts).  Padding rows
+    (mask 0) contribute nothing.  The calibration counts piggyback on
+    the logits this function already holds — the experiment-truth
+    layer's zero-extra-pass rule (DESIGN.md §13)."""
     k = min(top_k, num_classes)
     _, topk_pred = jax.lax.top_k(logits, k)
     hit_topk = (topk_pred == labels[:, None]).any(axis=1)
     top1 = topk_pred[:, 0] == labels
     maskf = mask.astype(jnp.float32)
     onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32) * maskf[:, None]
+    conf = jnp.max(jax.nn.softmax(logits.astype(jnp.float32), axis=-1),
+                   axis=-1)
+    cal_bin = jnp.clip((conf * NUM_CAL_BINS).astype(jnp.int32), 0,
+                       NUM_CAL_BINS - 1)
+    cal_onehot = jax.nn.one_hot(cal_bin, NUM_CAL_BINS,
+                                dtype=jnp.float32) * maskf[:, None]
     return {
         "top_1_correct": jnp.sum(top1 * maskf),
         "top_k_correct": jnp.sum(hit_topk * maskf),
         "corrects_byclass": jnp.sum(onehot * (top1 * maskf)[:, None], axis=0),
         "count_byclass": jnp.sum(onehot, axis=0),
         "count": jnp.sum(maskf),
+        "cal_count": jnp.sum(cal_onehot, axis=0),
+        "cal_correct": jnp.sum(cal_onehot * (top1 * maskf)[:, None],
+                               axis=0),
+        "cal_conf_sum": jnp.sum(cal_onehot * conf[:, None], axis=0),
     }
 
 
@@ -87,6 +107,9 @@ def accumulate_metrics(count_iter: Iterator[Dict[str, jnp.ndarray]]
             "corrects_byclass": np.zeros(0, np.float32),
             "count_byclass": np.zeros(0, np.float32),
             "count": np.float32(0.0),
+            "cal_count": np.zeros(NUM_CAL_BINS, np.float32),
+            "cal_correct": np.zeros(NUM_CAL_BINS, np.float32),
+            "cal_conf_sum": np.zeros(NUM_CAL_BINS, np.float32),
         }
     count = max(totals["count"], 1.0)
     with np.errstate(divide="ignore", invalid="ignore"):
@@ -98,4 +121,9 @@ def accumulate_metrics(count_iter: Iterator[Dict[str, jnp.ndarray]]
         "corrects_byclass": totals["corrects_byclass"],
         "count_byclass": totals["count_byclass"],
         "count": count,
+        # Calibration bins ride the same accumulation (ECE derives on
+        # host: telemetry/diagnostics.ece_from_counts).
+        "cal_count": totals["cal_count"],
+        "cal_correct": totals["cal_correct"],
+        "cal_conf_sum": totals["cal_conf_sum"],
     }

@@ -78,13 +78,24 @@ class JsonlSink(MetricsSink):
     written both inline and as separate files under ``assets/`` so the
     queried-index audit trail survives like the reference's
     ``labeled_idxs_per_round.txt`` (strategy.py:480-483).
+
+    ``rotate_bytes``: size-based rotation for run-indefinitely services
+    (ROADMAP item 3 — an unbounded stream on a long-lived streaming-AL
+    process eventually fills the disk).  When a write pushes the file
+    past the cap, metrics.jsonl is atomically renamed to
+    metrics.jsonl.1 (replacing any previous .1) and a fresh file opens
+    — all under the sink lock, BETWEEN whole lines, so no event is ever
+    split or lost across the boundary (pinned in
+    tests/test_diagnostics.py).  0 (default) = never rotate.
     """
 
-    def __init__(self, directory: str, experiment_key: Optional[str] = None):
+    def __init__(self, directory: str, experiment_key: Optional[str] = None,
+                 rotate_bytes: int = 0):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         os.makedirs(os.path.join(directory, "assets"), exist_ok=True)
         self.experiment_key = experiment_key or uuid.uuid4().hex[:9]
+        self.rotate_bytes = int(rotate_bytes or 0)
         self._path = os.path.join(directory, "metrics.jsonl")
         self._fh = open(self._path, "a")
         # The telemetry watchdog emits ``stall_suspected`` from its own
@@ -97,6 +108,22 @@ class JsonlSink(MetricsSink):
         with self._lock:
             self._fh.write(line)
             self._fh.flush()
+            if (self.rotate_bytes > 0
+                    and self._fh.tell() >= self.rotate_bytes):
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Rotate under the held lock: close, atomically rename to .1
+        (os.replace — readers see either the old whole file or the new
+        one, never a truncation), reopen fresh.  A failed rename keeps
+        appending to the same path (past the cap, but alive) — a
+        rotation hiccup must not cost events."""
+        self._fh.close()
+        try:
+            os.replace(self._path, self._path + ".1")
+        except OSError:
+            pass
+        self._fh = open(self._path, "a")
 
     def log_parameters(self, params):
         self._emit({"kind": "params", "params": params})
@@ -280,10 +307,13 @@ SINK_BACKENDS = {
 
 def make_sink(enable: bool, directory: str,
               experiment_key: Optional[str] = None,
-              backend: str = "jsonl") -> MetricsSink:
+              backend: str = "jsonl",
+              rotate_bytes: int = 0) -> MetricsSink:
     """Build the configured sink(s); ``backend`` is a comma-separated list
     of SINK_BACKENDS names (unknown names raise — a typo must not
-    silently drop an experiment's metrics)."""
+    silently drop an experiment's metrics).  ``rotate_bytes`` applies to
+    the jsonl backend only (the other backends have no append-forever
+    file to bound)."""
     if not enable:
         return NullSink()
     names = [b.strip() for b in backend.split(",") if b.strip()]
@@ -302,7 +332,10 @@ def make_sink(enable: bool, directory: str,
             raise ValueError(
                 f"Unknown metrics backend {name!r}; expected one of "
                 f"{sorted(SINK_BACKENDS)}") from None
-        sinks.append(cls(directory, experiment_key=experiment_key))
+        kwargs = ({"rotate_bytes": rotate_bytes} if cls is JsonlSink
+                  else {})
+        sinks.append(cls(directory, experiment_key=experiment_key,
+                         **kwargs))
     if len(sinks) == 1:
         return sinks[0]
     return MultiSink(sinks)

@@ -196,11 +196,12 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # pipeline/overlap on both end-to-end round phases — ISSUE 7, ~90
 # bytes — the failure-model counters retries/degraded on both round
 # phases — ISSUE 8, worst case '"retries":NN,"degraded":N,' x2 ≈ 50
-# bytes — and now the gradient-path riders on both TRAIN phases —
-# ISSUE 10, worst case '"bwd_frac":0.NNN,"grad_ar":"int8",' x2 ≈ 68
-# bytes) without truncation; staged truncation in _compact_line still
-# guards the pathological cases.  Pinned by unit tests at both
-# extremes.
+# bytes — the gradient-path riders on both TRAIN phases — ISSUE 10,
+# worst case '"bwd_frac":0.NNN,"grad_ar":"int8",' x2 ≈ 68 bytes — and
+# now the experiment-truth drift rider on both round phases — ISSUE
+# 13, worst case '"drift":0.NNNNNN,' x2 ≈ 36 bytes) without
+# truncation; staged truncation in _compact_line still guards the
+# pathological cases.  Pinned by unit tests at both extremes.
 MAX_LINE_BYTES = 1900
 
 
@@ -1477,6 +1478,14 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         "overlap_frac": overlap,
         "round_vs_max_phase": vs_max,
         "spec_hit_frac": spec_hit,
+        # The experiment-truth rider (DESIGN.md §13): round 1's
+        # score-distribution drift vs round 0 from the driver's own
+        # diagnostics stream — an end-to-end round capture now records
+        # whether the acquisition distribution moved while it was being
+        # timed (None when diagnostics were off or round 0 never
+        # scored).
+        "rd_score_drift_psi": round_metric("rd_score_drift_psi", 1),
+        "rd_score_drift_js": round_metric("rd_score_drift_js", 1),
         # The failure model's self-healing counters (DESIGN.md §10),
         # from the same driver stream: site-level retries absorbed and
         # degradation-ladder escalations taken during the measured
@@ -2379,7 +2388,12 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                          *((("round_pipeline", "pipeline"),
                             ("overlap_frac", "overlap"),
                             ("fault_retries_total", "retries"),
-                            ("degrade_events", "degraded"))
+                            ("degrade_events", "degraded"),
+                            # The experiment-truth drift rider (ISSUE
+                            # 13): a timed round's score-distribution
+                            # shift rides the line; the JS twin stays
+                            # in the evidence file.
+                            ("rd_score_drift_psi", "drift"))
                            if name.startswith("al_round") else ()),
                          # The gradient-path riders (ISSUE 10) ride only
                          # the TRAIN phases (their subject): the

@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """al_lint: the whole-package static-analysis CLI (DESIGN.md §12).
 
-Runs the 14-check registry (10 legacy trace_lint invariants + the
-lock-discipline / donation-safety / recompile-hazard / collective-axis
+Runs the 15-check registry (10 legacy trace_lint invariants + the
+lock-discipline / donation-safety / recompile-hazard /
+collective-axis / diagnostics-inert
 deep checkers) over active_learning_tpu/, bench.py, and scripts/
 through ONE shared-parse AST cache.
 

@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# preflight.sh — the merge gate, reproduced locally with one command.
+#
+#   bash scripts/preflight.sh
+#
+# Chains the four gates a change must clear, fail-fast, in cost order:
+#
+#   1. al_lint         the 15-check static analysis (seconds, no jax)
+#   2. tier-1 tests    the ROADMAP.md tier-1 recipe (CPU 8-device mesh)
+#   3. bench smoke     the degraded-mode contract: bench.py with the
+#                      wall-clock budget pre-exhausted and a redirected
+#                      state dir must still emit its strict-parseable
+#                      final JSON line (the driver-parseable guarantee)
+#   4. run_report      scripts/run_report.py --selftest (the reporting
+#                      layer renders synthetic runs end to end)
+#
+# Exit codes: 0 = every gate green; otherwise the exit code of the
+# FIRST failing gate (1 = lint findings or test/selftest failures,
+# 2 = usage/collection errors, >=124 = a timeout) — `set -e` stops at
+# the first red, so the last line printed names the failing gate.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO"
+
+echo "== preflight 1/4: al_lint (static analysis) =="
+python scripts/al_lint.py
+
+echo "== preflight 2/4: tier-1 tests =="
+# The tier-1 recipe (ROADMAP.md): CPU backend, virtual 8-device mesh
+# via tests/conftest.py, slow tier excluded.
+set -o pipefail
+rm -f /tmp/_preflight_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_preflight_t1.log
+
+echo "== preflight 3/4: bench degraded-mode smoke =="
+# Budget pre-exhausted + redirected state dir (the repo's captured
+# evidence must never be clobbered): the final stdout line must still
+# be strict JSON with the headline schema — the same contract
+# tests/test_bench_json.py pins, checked here without pytest.
+BENCH_STATE="$(mktemp -d)"
+trap 'rm -rf "$BENCH_STATE"' EXIT
+env -u XLA_FLAGS JAX_PLATFORMS=cpu AL_BENCH_STATE_DIR="$BENCH_STATE" \
+    AL_BENCH_BUDGET_S=0 python bench.py > "$BENCH_STATE/out.txt"
+python - "$BENCH_STATE/out.txt" <<'EOF'
+import json, sys
+lines = [l for l in open(sys.argv[1]).read().splitlines() if l.strip()]
+assert lines, "bench printed nothing to stdout"
+out = json.loads(lines[-1])  # strict: NaN/Inf tokens would raise
+for key in ("metric", "value", "unit", "phases", "evidence"):
+    assert key in out, f"bench line missing {key!r}"
+print("bench degraded-mode line: ok")
+EOF
+
+echo "== preflight 4/4: run_report selftest =="
+python scripts/run_report.py --selftest
+
+echo "preflight: ALL GATES GREEN"

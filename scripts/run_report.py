@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""run_report: render per-run label-efficiency reports and cross-run
+strategy comparisons at matched label budgets (DESIGN.md §13).
+
+    python scripts/run_report.py <log_dir>              # one run's curve
+    python scripts/run_report.py <dir_a> <dir_b> ...    # comparison table
+    python scripts/run_report.py --selftest             # preflight link
+    python scripts/run_report.py <dir> --json           # machine-readable
+
+Thin CLI over active_learning_tpu/telemetry/report.py (the ``report``
+verb of the main CLI), kept as a script so the preflight gate and ops
+shells can run it with no package install.  Stdlib only, no jax import
+— safe against a wedged or backend-less tree.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from active_learning_tpu.telemetry.report import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,23 @@
+"""Golden negative fixture for the diagnostics-inert check: a
+"host-pure" diagnostics module that imports jax and syncs the device,
+plus a strategy hook that reads .diagnostics with no flag gate — each
+line below is a finding the checker must produce."""
+
+_DIAGNOSTICS_HOST_PURE = True
+
+import jax  # host-purity violation: jax import in a host-pure module
+import numpy as np
+
+
+def fetch_scores(device_scores):
+    # host-purity violation: a device sync inside the diagnostics layer
+    # (the caller must hand host arrays in).
+    return np.asarray(jax.device_get(device_scores))
+
+
+class LeakyStrategy:
+    def query_hot_path(self, out):
+        # gated-access violation: an unconditional .diagnostics hook on
+        # the hot path — no if/ternary gate anywhere in the function.
+        self.diagnostics.observe_scores("margin", out["margin"])
+        return out

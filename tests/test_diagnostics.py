@@ -1,0 +1,539 @@
+"""The experiment-truth observability layer (DESIGN.md §13), pinned.
+
+  * the mergeable fixed-bin histogram: chunked / sharded / monolithic
+    accumulation bit-equal; spec mismatches raise; PSI/JS honesty rules
+    (None below MIN_DRIFT_N, 0 on identical, positive on shift);
+  * ECE from the eval step's additive calibration counts;
+  * k-center pick distances ride out of the selection scans with picks
+    unchanged — batched == q=1 == row-sharded, monotone non-increasing
+    for deterministic greedy, NaN on the seed;
+  * the off-path contract: diagnostics disabled is one None check per
+    hook site (<2.5µs/call, same bound as disarmed fault sites);
+  * JsonlSink size rotation: atomic, lock-held, no lost lines;
+  * serve-side drift: live histogram, checkpoint-time rebaseline, and
+    the Prometheus exposition of the histogram + drift gauges;
+  * e2e through the production CLI: a 2-round run with diagnostics on
+    vs off produces BIT-IDENTICAL experiment state (margin family AND
+    k-center family, 8-device CPU mesh), the on-run emits
+    rd_score_drift_* through sink + scrape, run_report.json renders a
+    two-run strategy comparison, and `status` shows the drift tail.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from active_learning_tpu.telemetry import diagnostics as diag_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# The histogram
+# ---------------------------------------------------------------------------
+
+class TestScoreHistogram:
+    def test_chunked_and_sharded_merges_bit_equal_monolithic(self, rng):
+        values = rng.random(5000).astype(np.float32)
+        mono = diag_lib.histogram_for("margin").add(values)
+        # Chunked (the speculative consume path: per-chunk partials
+        # summed at consume) — uneven chunk sizes on purpose.
+        chunked = diag_lib.histogram_from_chunks(
+            "margin", np.array_split(values, 13))
+        # Sharded (row-sharded pools: per-shard partial counts are
+        # psum-able because bin counts are pure integer sums).
+        shards = [diag_lib.histogram_for("margin").add(part)
+                  for part in np.array_split(values, 8)]
+        sharded = diag_lib.histogram_from_chunks("margin", shards)
+        for other in (chunked, sharded):
+            assert (mono.counts == other.counts).all()
+            assert mono.n == other.n
+            assert mono.summary() == other.summary()
+
+    def test_out_of_range_clamps_and_nan_drops(self):
+        h = diag_lib.histogram_for("margin")
+        h.add(np.array([-1.0, 2.0, np.nan, 0.5]))
+        assert h.n == 3 and h.n_nan == 1
+        assert h.counts[0] == 1 and h.counts[-1] == 1
+
+    def test_spec_mismatch_raises(self):
+        a = diag_lib.histogram_for("margin")
+        b = diag_lib.histogram_for("entropy")
+        with pytest.raises(ValueError, match="specs"):
+            a.merge(b)
+        with pytest.raises(ValueError, match="undefined"):
+            diag_lib.psi(a, b)
+
+    def test_round_trip_dict(self, rng):
+        h = diag_lib.histogram_for("kcenter_dist").add(rng.random(100) * 50)
+        h2 = diag_lib.ScoreHistogram.from_dict(h.to_dict())
+        assert h.same_spec(h2) and (h.counts == h2.counts).all()
+        assert h.summary() == h2.summary()
+
+
+class TestDrift:
+    def test_identical_zero_shifted_positive(self, rng):
+        a = diag_lib.histogram_for("margin").add(rng.random(2000))
+        b = diag_lib.histogram_for("margin").add(rng.random(2000) * 0.3)
+        assert diag_lib.psi(a, a) == 0.0
+        assert diag_lib.js_divergence(a, a) == 0.0
+        assert diag_lib.psi(a, b) > 0.1
+        js = diag_lib.js_divergence(a, b)
+        assert 0.0 < js <= np.log(2) + 1e-9
+
+    def test_below_min_n_is_none_not_a_number(self):
+        a = diag_lib.histogram_for("margin").add(
+            np.full(diag_lib.MIN_DRIFT_N - 1, 0.5))
+        b = diag_lib.histogram_for("margin").add(np.full(100, 0.9))
+        assert diag_lib.psi(a, b) is None
+        assert diag_lib.js_divergence(a, b) is None
+
+
+class TestCalibrationAndComposition:
+    def test_ece_perfect_and_known_gap(self):
+        nb = diag_lib.NUM_CAL_BINS
+        count = np.zeros(nb)
+        correct = np.zeros(nb)
+        conf = np.zeros(nb)
+        # One populated bin: 100 rows at confidence 0.75, 75 correct —
+        # perfectly calibrated.
+        count[7], correct[7], conf[7] = 100, 75, 75.0
+        assert diag_lib.ece_from_counts(count, correct, conf) == \
+            pytest.approx(0.0)
+        # Same confidence, 50 correct: gap 0.25.
+        correct[7] = 50
+        assert diag_lib.ece_from_counts(count, correct, conf) == \
+            pytest.approx(0.25)
+        assert diag_lib.ece_from_counts(np.zeros(nb), np.zeros(nb),
+                                        np.zeros(nb)) is None
+
+    def test_eval_step_counts_feed_ece(self):
+        """The eval-batch piggyback: batch_metric_counts' calibration
+        bins are additive and ece_from_counts consumes them."""
+        import jax.numpy as jnp
+        from active_learning_tpu.train.evaluation import (
+            accumulate_metrics, batch_metric_counts)
+
+        logits = jnp.asarray([[4.0, 0.0, 0.0], [0.0, 3.0, 0.0],
+                              [0.0, 0.0, 2.0], [1.0, 0.9, 0.0]])
+        labels = jnp.asarray([0, 1, 0, 1])
+        mask = jnp.ones(4)
+        counts = batch_metric_counts(logits, labels, mask, 3)
+        out = accumulate_metrics(iter([counts]))
+        assert float(np.sum(out["cal_count"])) == 4.0
+        ece = diag_lib.ece_from_counts(out["cal_count"],
+                                       out["cal_correct"],
+                                       out["cal_conf_sum"])
+        assert ece is not None and 0.0 <= ece <= 1.0
+
+    def test_pick_composition_balance_and_novelty(self):
+        targets = np.array([0, 0, 1, 1, 2, 2, 3, 3])
+        labeled_before = np.zeros(8, dtype=bool)
+        labeled_before[0] = True  # class 0 already seen
+        comp = diag_lib.pick_composition(
+            np.array([1, 2, 4, 6]), targets, labeled_before, 4)
+        # 4 picks over 4 distinct classes: perfectly balanced.
+        assert comp["class_balance"] == pytest.approx(1.0)
+        # Classes 1/2/3 are novel, class 0 is not: 3/4.
+        assert comp["novelty"] == pytest.approx(0.75)
+        empty = diag_lib.pick_composition(np.zeros(0, np.int64),
+                                          targets, labeled_before, 4)
+        assert empty["class_balance"] is None
+
+
+# ---------------------------------------------------------------------------
+# k-center pick distances out of the selection scans
+# ---------------------------------------------------------------------------
+
+class TestKcenterPickDists:
+    def _dists(self, emb, labeled, budget, **kw):
+        from active_learning_tpu.strategies import kcenter
+        picks = kcenter.kcenter_greedy((emb,), labeled, budget, **kw)
+        dists = kcenter.LAST_PICK_DISTS
+        assert dists is not None and len(dists) == len(picks)
+        return picks, dists
+
+    def test_deterministic_dists_exact_and_monotone(self, rng):
+        emb = rng.normal(size=(64, 6)).astype(np.float32)
+        labeled = np.zeros(64, dtype=bool)
+        labeled[:4] = True
+        picks, dists = self._dists(emb, labeled, 10, randomize=False,
+                                   rng=rng, batch_q=1)
+        assert np.isfinite(dists).all()
+        # Greedy farthest-first distances never increase, and each
+        # equals the exact min squared distance to labeled ∪ earlier
+        # picks — recomputed here the slow way.
+        assert (np.diff(dists) <= 1e-4).all()
+        chosen = list(np.flatnonzero(labeled))
+        for pick, d in zip(picks, dists):
+            ref = min(float(np.sum((emb[pick] - emb[j]) ** 2))
+                      for j in chosen)
+            assert d == pytest.approx(ref, rel=1e-3, abs=1e-3)
+            chosen.append(int(pick))
+
+    def test_batched_matches_q1_and_sharded_matches_replicated(self, rng):
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        emb = rng.normal(size=(128, 8)).astype(np.float32)
+        labeled = np.zeros(128, dtype=bool)
+        labeled[:8] = True
+        _, d_q1 = self._dists(emb, labeled, 16, randomize=False,
+                              rng=np.random.default_rng(0), batch_q=1)
+        p8, d_q8 = self._dists(emb, labeled, 16, randomize=False,
+                               rng=np.random.default_rng(0), batch_q=8)
+        np.testing.assert_allclose(d_q1, d_q8, rtol=1e-5, atol=1e-5)
+        mesh = mesh_lib.make_mesh()
+        p_row, d_row = self._dists(emb, labeled, 16, randomize=False,
+                                   rng=np.random.default_rng(0),
+                                   batch_q=8, mesh=mesh,
+                                   pool_sharding="row")
+        np.testing.assert_array_equal(p8, p_row)
+        np.testing.assert_allclose(d_q8, d_row, rtol=1e-5, atol=1e-5)
+
+    def test_seed_pick_is_nan(self, rng):
+        emb = rng.normal(size=(32, 4)).astype(np.float32)
+        labeled = np.zeros(32, dtype=bool)  # nothing labeled: seed first
+        picks, dists = self._dists(emb, labeled, 5, randomize=False,
+                                   rng=rng, batch_q=1)
+        assert np.isnan(dists[0]) and np.isfinite(dists[1:]).all()
+
+
+# ---------------------------------------------------------------------------
+# Off-path cost + hook inertness
+# ---------------------------------------------------------------------------
+
+class TestOffPathCost:
+    def test_disabled_hooks_under_microsecond_budget(self):
+        """Diagnostics off = one None check per site: 100k calls per
+        hook in well under a second even on a loaded CI box (~2.5µs/
+        call allowed — the same bound as disarmed fault sites)."""
+        from active_learning_tpu.strategies.base import Strategy
+
+        s = object.__new__(Strategy)
+        s.diagnostics = None
+        out = {"margin": np.zeros(4, np.float32)}
+        n = 100_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            s._record_score_diagnostics(out)
+            s._record_pick_dist_diagnostics(None)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.5, (
+            f"{elapsed / (2 * n) * 1e6:.2f}µs per disabled hook")
+
+    def test_gauges_in_per_round_registry(self):
+        from active_learning_tpu.experiment.driver import (
+            DIAGNOSTICS_GAUGES, PER_ROUND_GAUGES)
+        for name in ("rd_score_drift_psi", "rd_score_drift_js",
+                     "rd_score_mean", "rd_pick_class_balance",
+                     "rd_pick_novelty", "rd_pick_min_dist",
+                     "rd_pick_mean_dist", "rd_ece"):
+            assert name in DIAGNOSTICS_GAUGES
+            assert name in PER_ROUND_GAUGES
+
+    def test_stale_drift_gauge_retracted_from_scrape_set(self):
+        """A round whose diagnostics produced no drift must POP the
+        previous round's gauge from the scrape (the honesty rule's
+        scrape-side half): finish_round reports the key as None, and
+        the driver's retraction feeds those Nones to set_gauges, which
+        drops them."""
+        from active_learning_tpu.experiment.driver import (
+            DIAGNOSTICS_GAUGES)
+        from active_learning_tpu.telemetry.runtime import RunTelemetry
+
+        diag = diag_lib.RoundDiagnostics(num_classes=4)
+        rt = RunTelemetry()
+        rng = np.random.default_rng(0)
+        # Round 1/2 score enough: drift lands in the gauges.
+        diag.observe_scores("margin", rng.random(100))
+        diag.finish_round(1)
+        diag.observe_scores("margin", rng.random(100) * 0.3)
+        g2 = diag.finish_round(2)
+        assert g2["rd_score_drift_psi"] is not None
+        rt.set_gauges(**{k: v for k, v in g2.items() if v is not None})
+        assert "rd_score_drift_psi" in rt.gauges()
+        # Round 3 scores below MIN_DRIFT_N: drift is honesty-None, and
+        # the retraction must clear the stale value.
+        diag.observe_scores("margin", rng.random(4))
+        g3 = diag.finish_round(3)
+        assert g3.get("rd_score_drift_psi") is None
+        rt.set_gauges(**{k: v for k, v in g3.items() if v is not None})
+        rt.set_gauges(**{k: None for k in DIAGNOSTICS_GAUGES
+                         if g3.get(k) is None})
+        assert "rd_score_drift_psi" not in rt.gauges()
+        assert "rd_score_drift_js" not in rt.gauges()
+
+
+# ---------------------------------------------------------------------------
+# JsonlSink rotation
+# ---------------------------------------------------------------------------
+
+class TestJsonlRotation:
+    def test_rotation_atomic_no_lost_lines(self, tmp_path):
+        from active_learning_tpu.utils.metrics import JsonlSink
+        sink = JsonlSink(str(tmp_path), experiment_key="k",
+                         rotate_bytes=2048)
+        n = 300
+        for i in range(n):
+            sink.log_metric("m", float(i), step=i)
+        sink.close()
+        live = os.path.join(tmp_path, "metrics.jsonl")
+        rotated = live + ".1"
+        assert os.path.exists(rotated), "cap never triggered a rotation"
+        assert os.path.getsize(live) < 2048 + 256
+        seen = []
+        for path in (rotated, live):
+            with open(path) as fh:
+                for line in fh:
+                    ev = json.loads(line)  # every line whole + parseable
+                    if ev.get("kind") == "metric":
+                        seen.append(ev["step"])
+        # The .1 file only holds the LAST generation before the live
+        # file; earlier generations age out.  Within what survives,
+        # steps are contiguous through the boundary and end at n-1 —
+        # no event was lost or torn AT a rotation.
+        assert seen == list(range(seen[0], n))
+
+    def test_make_sink_threads_rotate_bytes(self, tmp_path):
+        from active_learning_tpu.utils.metrics import make_sink
+        sink = make_sink(True, str(tmp_path), backend="jsonl",
+                         rotate_bytes=4096)
+        assert sink.rotate_bytes == 4096
+        sink.close()
+
+    def test_cli_threads_rotation_and_diagnostics_flags(self):
+        from active_learning_tpu.experiment import cli
+        ns = cli.get_parser().parse_args(
+            ["--dataset", "synthetic", "--metrics_rotate_bytes", "9000",
+             "--disable_diagnostics"])
+        cfg = cli.args_to_config(ns)
+        assert cfg.metrics_rotate_bytes == 9000
+        assert cfg.telemetry.diagnostics is False
+        cfg2 = cli.args_to_config(cli.get_parser().parse_args(
+            ["--dataset", "synthetic"]))
+        assert cfg2.telemetry.diagnostics is True
+
+
+# ---------------------------------------------------------------------------
+# Serve-side drift
+# ---------------------------------------------------------------------------
+
+class TestServeScoreDrift:
+    def test_observe_rebaseline_snapshot(self, rng):
+        d = diag_lib.ServeScoreDrift(key="margin")
+        d.observe(rng.random(500))
+        snap = d.snapshot()
+        assert snap["psi"] is None and snap["baseline_round"] is None
+        d.rebaseline(served_round=3)
+        d.observe(rng.random(500) * 0.2)  # the new model scores low
+        snap = d.snapshot()
+        assert snap["baseline_round"] == 3
+        assert snap["psi"] is not None and snap["psi"] > 0.1
+        assert snap["live"]["n"] == 500
+
+    def test_prometheus_exposition_of_hist_and_drift(self, rng):
+        from active_learning_tpu.serve.metrics import prometheus_samples
+        from active_learning_tpu.telemetry import prom as prom_lib
+        d = diag_lib.ServeScoreDrift(key="margin")
+        d.observe(rng.random(300))
+        d.rebaseline(served_round=1)
+        d.observe(rng.random(300) * 0.5)
+        snap = {"score_drift": d.snapshot()}
+        text = prom_lib.render(prometheus_samples(snap))
+        parsed = prom_lib.parse(text)
+        assert "al_serve_score_drift_psi" in parsed
+        assert "al_serve_score_drift_js" in parsed
+        assert parsed["al_serve_score_baseline_round"][()] == 1.0
+        buckets = parsed["al_serve_score_hist_bucket"]
+        inf = buckets[(("key", "margin"), ("le", "+Inf"))]
+        assert inf == 300.0  # the live histogram's total
+        assert parsed["al_serve_score_hist_count"][
+            (("key", "margin"),)] == 300.0
+
+    def test_log1p_bucket_edges_exposed_in_score_space(self, rng):
+        """A log1p-spec histogram's Prometheus `le` labels must be in
+        SCORE space (expm1 of the transformed ladder), not the
+        transformed coordinates the bins live in."""
+        import math
+        from active_learning_tpu.serve.metrics import prometheus_samples
+        d = diag_lib.ServeScoreDrift(key="min_margin")  # log1p spec
+        d.observe(rng.random(64) * 20.0)
+        samples = prometheus_samples({"score_drift": d.snapshot()})
+        edges = [float(labels["le"]) for name, labels, _ in samples
+                 if name == "al_serve_score_hist_bucket"
+                 and labels["le"] != "+Inf"]
+        lo, hi, bins, _ = diag_lib.SCORE_SPECS["min_margin"]
+        assert edges[-1] == pytest.approx(math.expm1(hi), rel=1e-4)
+        assert edges[0] == pytest.approx(
+            math.expm1((hi - lo) / bins), rel=1e-4)
+
+    def test_snapshot_dict_built_under_lock(self, rng):
+        """snapshot() must serialize the live histogram while holding
+        the lock (a concurrent observe() otherwise exposes a
+        count/bucket mismatch to a scrape) — pinned by hammering
+        observe from a thread while snapshotting."""
+        import threading
+        d = diag_lib.ServeScoreDrift(key="margin")
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                d.observe(np.full(17, 0.5))
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(200):
+                live = d.snapshot()["live"]
+                assert sum(live["counts"]) == live["n"]
+        finally:
+            stop.set()
+            t.join()
+
+
+# ---------------------------------------------------------------------------
+# e2e: production CLI, bit-neutrality, reports, status
+# ---------------------------------------------------------------------------
+
+def _cli_run(tmp, tag, strategy, extra=()):
+    """One 2-round production-CLI run over synthetic data on the
+    8-device CPU mesh; returns its (log_dir, state_dir)."""
+    from active_learning_tpu.experiment import cli
+    log_dir = os.path.join(tmp, tag)
+    argv = ["--dataset", "synthetic", "--arg_pool", "synthetic",
+            "--strategy", strategy, "--rounds", "2",
+            "--round_budget", "24", "--init_pool_size", "0",
+            "--n_epoch", "1", "--early_stop_patience", "1",
+            "--log_dir", log_dir, "--ckpt_path", log_dir,
+            "--exp_hash", tag, *extra]
+    cli.main(argv)
+    state_dir = os.path.join(log_dir, f"active_learning_{tag}")
+    return log_dir, state_dir
+
+
+@pytest.fixture(scope="module")
+def e2e_runs(tmp_path_factory):
+    """Four production-CLI runs: {margin, k-center} × {diagnostics on,
+    off}, same seeds — the bit-neutrality and report corpus."""
+    tmp = str(tmp_path_factory.mktemp("diag_e2e"))
+    runs = {}
+    for family, strategy in (("margin", "MarginSampler"),
+                             ("kcenter", "CoresetSampler")):
+        runs[family, "on"] = _cli_run(tmp, f"{family}on", strategy)
+        runs[family, "off"] = _cli_run(
+            tmp, f"{family}off", strategy,
+            extra=("--disable_diagnostics",))
+    return runs
+
+
+def _metric_events(log_dir):
+    by = {}
+    with open(os.path.join(log_dir, "metrics.jsonl")) as fh:
+        for line in fh:
+            ev = json.loads(line)
+            if ev.get("kind") == "metric":
+                for k, v in ev["metrics"].items():
+                    by.setdefault(k, []).append((ev.get("step"), v))
+    return by
+
+
+class TestEndToEnd:
+    @pytest.mark.parametrize("family", ["margin", "kcenter"])
+    def test_bit_identical_experiment_state_on_vs_off(self, e2e_runs,
+                                                      family):
+        """THE acceptance pin: diagnostics on vs off, same seeds, same
+        2-round production run — labeled/recent/eval idxs, cost, round,
+        init key, and the host rng chain all bit-identical."""
+        state = {}
+        for mode in ("on", "off"):
+            _, state_dir = e2e_runs[family, mode]
+            state[mode] = dict(np.load(os.path.join(
+                state_dir, "experiment_state.npz")))
+        assert sorted(state["on"]) == sorted(state["off"])
+        for key in state["on"]:
+            np.testing.assert_array_equal(
+                state["on"][key], state["off"][key], err_msg=key)
+        rngs = []
+        for mode in ("on", "off"):
+            _, state_dir = e2e_runs[family, mode]
+            meta = json.load(open(os.path.join(
+                state_dir, "experiment_state.json")))
+            rngs.append(json.dumps(meta["rng_state"], sort_keys=True))
+        assert rngs[0] == rngs[1]
+
+    @pytest.mark.parametrize("family", ["margin", "kcenter"])
+    def test_drift_emitted_through_sink(self, e2e_runs, family):
+        """rd_score_drift_psi/js at round >= 1 in the diagnostics-on
+        runs (margin family via the score histogram, k-center via pick
+        distances), absent in the off runs."""
+        on = _metric_events(e2e_runs[family, "on"][0])
+        off = _metric_events(e2e_runs[family, "off"][0])
+        for name in ("rd_score_drift_psi", "rd_score_drift_js"):
+            assert name in on, f"{name} missing ({family})"
+            assert all(step >= 1 for step, _ in on[name])
+            assert name not in off
+        assert "rd_pick_class_balance" in on
+        if family == "kcenter":
+            assert "rd_pick_min_dist" in on
+            assert "rd_pick_mean_dist" in on
+
+    def test_run_report_artifact_and_comparison(self, e2e_runs):
+        """run_report.json per run, and the cross-run strategy
+        comparison table from two REAL experiment dirs — the paper's
+        headline figure as a machine artifact."""
+        from active_learning_tpu.telemetry import report as report_lib
+        margin_dir = e2e_runs["margin", "on"][0]
+        kcenter_dir = e2e_runs["kcenter", "on"][0]
+        for d in (margin_dir, kcenter_dir):
+            payload = json.load(open(os.path.join(d, "run_report.json")))
+            assert payload["schema"] == 1
+            rounds = payload["rounds"]
+            assert [r["round"] for r in rounds] == [0, 1]
+            for r in rounds:
+                assert r["labeled"] > 0
+                assert r["test_accuracy"] is not None
+                assert r["round_time_s"] > 0
+        runs = [report_lib.load_run(margin_dir),
+                report_lib.load_run(kcenter_dir)]
+        table = report_lib.render_compare(runs)
+        assert "matched" in table and "*" in table
+        assert "MarginSampler" in table and "CoresetSampler" in table
+        single = report_lib.render_single(runs[0])
+        assert "drift_psi" in single
+
+    def test_report_cli_verb_and_script(self, e2e_runs, capsys):
+        from active_learning_tpu.experiment import cli
+        margin_dir = e2e_runs["margin", "on"][0]
+        kcenter_dir = e2e_runs["kcenter", "on"][0]
+        assert cli.main(["report", margin_dir, kcenter_dir]) == 0
+        out = capsys.readouterr().out
+        assert "strategy comparison" in out
+        assert cli.main(["report", margin_dir]) == 0
+        assert "run report:" in capsys.readouterr().out
+
+    def test_status_renders_drift_tail(self, e2e_runs):
+        from active_learning_tpu.telemetry import status as status_lib
+        summary = status_lib.summarize(e2e_runs["margin", "on"][0])
+        text = status_lib.render_text(summary)
+        assert "drift / acquisition:" in text
+        assert "rd_score_drift_psi" in text
+
+    def test_prometheus_scrape_completeness_for_drift(self, e2e_runs,
+                                                      tmp_path):
+        """The new gauges honor the one-dict-two-channels contract:
+        re-running with a scrape file, every diagnostics metric that
+        reached the sink also rides the al_run_ scrape."""
+        from active_learning_tpu.telemetry import prom as prom_lib
+        prom_file = str(tmp_path / "run.prom")
+        log_dir, _ = _cli_run(str(tmp_path), "prom", "MarginSampler",
+                              extra=("--prometheus_file", prom_file))
+        by = _metric_events(log_dir)
+        parsed = prom_lib.parse(open(prom_file).read())
+        from active_learning_tpu.experiment.driver import PER_ROUND_GAUGES
+        for name in PER_ROUND_GAUGES:
+            if name in by:
+                assert f"al_run_{name}" in parsed, name
+        assert "al_run_rd_score_drift_psi" in parsed
+        assert "al_run_rd_score_drift_js" in parsed
