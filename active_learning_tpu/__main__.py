@@ -1,7 +1,9 @@
 """``python -m active_learning_tpu`` — the reference's ``python main_al.py``
-(README.md:53).  One extra verb beyond the reference surface:
-``python -m active_learning_tpu serve ...`` starts the online scoring
-service over an experiment's best checkpoint (serve/cli.py)."""
+(README.md:53).  Extra verbs beyond the reference surface: ``serve``
+(the online scoring service, serve/cli.py), ``stream`` (continual
+ingest -> score -> select on one persistent mesh, stream/cli.py),
+``status`` (live run summary), and ``report`` (label-efficiency
+curves)."""
 
 from .experiment.cli import main
 

@@ -1,4 +1,4 @@
-"""The checker registry: 10 ported legacy checks + 5 deep checkers.
+"""The checker registry: 10 ported legacy checks + 6 deep checkers.
 
 Ordered — the CLI lists and runs them in this order, and the per-check
 fixture test parametrizes over it.  Adding a check = appending here
@@ -13,6 +13,7 @@ from .donation import DonationSafetyChecker
 from .recompile import RecompileHazardChecker
 from .collective_axis import CollectiveAxisChecker
 from .diagnostics_inert import DiagnosticsInertChecker
+from .wal_before_ack import WalBeforeAckChecker
 
 DEEP_CHECKERS = (
     LockDisciplineChecker(),
@@ -20,6 +21,7 @@ DEEP_CHECKERS = (
     RecompileHazardChecker(),
     CollectiveAxisChecker(),
     DiagnosticsInertChecker(),
+    WalBeforeAckChecker(),
 )
 
 CHECKERS = tuple(LEGACY_CHECKERS) + DEEP_CHECKERS

@@ -1,0 +1,185 @@
+"""wal-before-ack: ingest handlers are durable-before-promise and
+host-pure.
+
+The streaming subsystem's one durability claim — "an acked row survives
+a kill at any point" — reduces to two properties of the closed handler
+registry in stream/ingest.py (``_INGEST_HANDLERS``), so this checker
+proves them statically instead of trusting review:
+
+  1. **WAL before ack.**  Inside every registered handler, no
+     ack-construction call (a call whose name matches the ``ack`` word
+     — ``ack_response``, ``make_ack``, ``ack`` ...) may appear lexically
+     before the WAL append (a ``.append(...)`` call on a wal-named
+     receiver).  The fsync inside ``IngestWAL.append`` is the promise;
+     an ack built first could be delivered by a code path that skipped
+     the write.  A handler that acks without ANY wal append is flagged
+     too.
+
+  2. **Host purity.**  A module declaring an ``_INGEST_HANDLERS``
+     registry may not import jax or reference the ``jax`` name: the ack
+     path must never wait on a device — admission, validation, the
+     fsync, and the queue push are numpy + stdlib (the same structural
+     incapability argument as diagnostics-inert's rule 1).
+
+Like the other annotation-based checkers the walk is LEXICAL: an
+ack-call textually after the append satisfies rule 1 even if control
+flow could skip the append (don't write that), and aliases of the wal
+object are recognized by name shape (``wal``, ``self.wal``,
+``ingest_wal``), not dataflow.
+
+The registry is closed: every name in ``_INGEST_HANDLERS`` must resolve
+to a module-level function — a registered-but-missing handler means the
+HTTP front end routes to something this checker never saw.
+
+Suppression: ``# al-lint: wal-ok <reason>`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ..engine import Checker, Context
+from ..findings import Finding
+
+_ACK_WORD = re.compile(r"(^|_)ack(_|$)")
+
+
+def _handler_registry(tree: ast.Module) -> Optional[List[str]]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_INGEST_HANDLERS"
+                for t in node.targets):
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                return []
+            return [elt.value for elt in node.value.elts
+                    if isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)]
+    return None
+
+
+def _call_name(node: ast.Call) -> str:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def _is_wal_append(node: ast.Call) -> bool:
+    """``<wal-named>.append(...)`` — the receiver's terminal name must
+    carry the wal word (``wal``, ``self.wal``, ``ingest_wal``)."""
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "append"):
+        return False
+    recv = node.func.value
+    name = ""
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    return "wal" in name.lower()
+
+
+class WalBeforeAckChecker(Checker):
+    id = "wal-before-ack"
+    title = ("ingest handlers append to the WAL before any ack and "
+             "stay host-pure (no jax)")
+    suppress_token = "wal-ok"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        problems: List[Finding] = []
+        for path in ctx.files:
+            tree, err = ctx.tree(path)
+            if err is not None:
+                continue  # parse failures are the legacy checks' finding
+            registry = _handler_registry(tree)
+            if registry is None:
+                continue
+            rel = ctx.rel(path)
+            self._check_host_pure(tree, rel, problems)
+            fns = {node.name: node for node in tree.body
+                   if isinstance(node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+            for name in registry:
+                fn = fns.get(name)
+                if fn is None:
+                    problems.append(Finding(
+                        check=self.id, path=rel, line=0,
+                        message=(f"_INGEST_HANDLERS names {name!r} but "
+                                 "no module-level function defines it — "
+                                 "the closed registry drifted from the "
+                                 "code"),
+                        hint="define the handler or fix the registry"))
+                    continue
+                self._check_ordering(fn, rel, problems)
+        return problems
+
+    # -- rule 1: WAL before ack -------------------------------------------
+
+    def _check_ordering(self, fn, rel, problems):
+        first_append: Optional[int] = None
+        acks = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_wal_append(node):
+                if first_append is None or node.lineno < first_append:
+                    first_append = node.lineno
+            elif _ACK_WORD.search(_call_name(node)):
+                acks.append(node)
+        for node in acks:
+            if first_append is None:
+                problems.append(Finding(
+                    check=self.id, path=rel, line=node.lineno,
+                    message=(f"'{fn.name}' acks "
+                             f"({_call_name(node)}) with NO WAL append "
+                             "anywhere in the handler — the ack is a "
+                             "durability promise nothing backs"),
+                    hint="append the record to the wal (fsync'd) before "
+                         "constructing the ack, or annotate "
+                         "'# al-lint: wal-ok <reason>'"))
+            elif node.lineno < first_append:
+                problems.append(Finding(
+                    check=self.id, path=rel, line=node.lineno,
+                    message=(f"'{fn.name}' constructs its ack "
+                             f"({_call_name(node)}) at line "
+                             f"{node.lineno}, BEFORE the WAL append at "
+                             f"line {first_append} — an ack must never "
+                             "exist until the record is durable"),
+                    hint="move the wal.append(...) above every "
+                         "ack-construction call, or annotate "
+                         "'# al-lint: wal-ok <reason>'"))
+
+    # -- rule 2: host purity ----------------------------------------------
+
+    def _check_host_pure(self, tree, rel, problems):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "jax":
+                        problems.append(self._pure_finding(
+                            rel, node.lineno,
+                            "imports jax — the ingest-handler module "
+                            "must stay numpy+stdlib (the ack path never "
+                            "waits on a device)"))
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "jax":
+                    problems.append(self._pure_finding(
+                        rel, node.lineno,
+                        "imports from jax — the ingest-handler module "
+                        "must stay numpy+stdlib"))
+            elif isinstance(node, ast.Name) and node.id == "jax":
+                problems.append(self._pure_finding(
+                    rel, node.lineno,
+                    "references the jax name inside the ingest-handler "
+                    "module"))
+
+    def _pure_finding(self, rel, line, message):
+        return Finding(
+            check=self.id, path=rel, line=line,
+            message=f"host-purity violation: {message}",
+            hint="move device work to the service thread (the handlers "
+                 "only validate, WAL-append, and queue), or annotate "
+                 "'# al-lint: wal-ok <reason>'")

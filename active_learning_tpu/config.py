@@ -277,6 +277,50 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """The streaming active-learning service (active_learning_tpu/stream/,
+    DESIGN.md §14): the ``stream`` CLI verb's knobs.  Like ServeConfig
+    this has no reference counterpart — the reference's AL loop is an
+    offline batch job over a frozen disk pool (PARITY.md row 58)."""
+
+    host: str = "127.0.0.1"
+    # 0 = ephemeral (the bound port is logged and exposed on the service
+    # object) — tests and the bench smoke phase run over loopback.
+    port: int = 8008
+    # Rows one POST /v1/pool may carry; beyond it the request is a
+    # non-retryable 413 (it could never be admitted — split it).
+    max_request_rows: int = 512
+    # Accepted-but-undrained rows the service will hold; beyond it
+    # ingest gets 429 + Retry-After until a round drains the backlog.
+    # Explicit backpressure, never unbounded queueing (the serve
+    # admission contract, applied to durability instead of batching).
+    max_backlog_rows: int = 65536
+    # Ingest-WAL segment rotation bound (stream/wal.py): the active
+    # wal.jsonl seals (atomic rename) past this many bytes.
+    wal_rotate_bytes: int = 64 << 20
+    # Trigger policy (stream/scheduler.TriggerPolicy): a round fires on
+    # the new-row watermark, on ServeScoreDrift PSI, or on the max wall
+    # interval — whichever first.  0 disables a condition.
+    watermark_rows: int = 1024
+    drift_psi: float = 0.25
+    max_interval_s: float = 3600.0
+    # Scheduler poll cadence between rounds.
+    poll_s: float = 0.5
+    # Stop after this many total rounds (the driver's ``rounds``
+    # semantics — a resumed run continues the same count); 0 = run
+    # indefinitely (the production mode; SIGTERM checkpoint-and-exits).
+    max_rounds: int = 0
+    # Extent floor for pool growth (pool.bucket_size's floor): appended
+    # capacity lands on this shape ladder so the resident upload and
+    # its gather runners recompile at most once per bucket boundary.
+    extent_floor: int = 256
+    # How many whole batches one incremental drift-scoring chunk covers
+    # (scoring.chunk_row_slices — the PR 7 chunk plan, reused over
+    # appended row ranges).
+    chunk_batches: int = 8
+
+
+@dataclasses.dataclass(frozen=True)
 class TelemetryConfig:
     """Run-wide telemetry (active_learning_tpu/telemetry/, DESIGN.md §7):
     per-step/per-epoch train + scoring metrics through the MetricsSink,

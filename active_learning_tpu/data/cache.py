@@ -254,6 +254,73 @@ class DecodedPoolCache:
         self._valid.flush()
 
 
+class GrowableRowStore:
+    """A row array in one disk file whose capacity grows by
+    ``pool.bucket_size``-aligned extents — the backing tier of the
+    streaming subsystem's growable candidate pool
+    (active_learning_tpu/stream/store.py).
+
+    Why extent-aligned: everything downstream that compiles against the
+    array's LEADING dimension (the resident-pool upload and its jitted
+    gather runners, parallel/resident.py) sees only capacities from the
+    same enumerable shape ladder the trainer and k-center already bucket
+    on — so a pool that grows row by row recompiles at most once per
+    bucket boundary, never once per append (pinned in
+    tests/test_compile_reuse.py).
+
+    Durability model: this file is DERIVED state.  The streaming
+    subsystem's source of truth is the fsync'd ingest WAL
+    (stream/wal.py); the store is rebuilt from base data + WAL replay at
+    every service start, so the store itself needs no write atomicity —
+    creation is still tmp+rename (a half-created file never masquerades
+    as a store) and growth is a plain ftruncate, which keeps every
+    EXISTING mapping valid (mappings cover the old length; only new
+    pages appear).  ``rows`` is re-mapped only when capacity grows, so
+    ``id(store.rows)`` is stable within a capacity epoch — exactly the
+    identity the resident cache keys on.
+    """
+
+    def __init__(self, path: str, row_shape, dtype=np.uint8,
+                 capacity: int = 0, extent_floor: int = 256):
+        from ..pool import bucket_size
+
+        self._bucket = lambda n: bucket_size(max(int(n), 1),
+                                             floor=int(extent_floor))
+        self.path = path
+        self.row_shape = tuple(int(d) for d in row_shape)
+        self.dtype = np.dtype(dtype)
+        self._row_bytes = int(np.prod(self.row_shape, dtype=np.int64)
+                              or 1) * self.dtype.itemsize
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.capacity = self._bucket(capacity)
+        # Fresh every construction: the store is derived (see docstring),
+        # and reusing a stale file would let a crashed run's rows shadow
+        # the WAL replay about to rebuild them.
+        with open(path + ".tmp", "wb") as fh:
+            fh.truncate(self.capacity * self._row_bytes)
+        os.replace(path + ".tmp", path)
+        self.rows = self._map()
+
+    def _map(self) -> np.ndarray:
+        return np.memmap(self.path, dtype=self.dtype, mode="r+",
+                         shape=(self.capacity, *self.row_shape))
+
+    def ensure_capacity(self, n_rows: int) -> bool:
+        """Grow (sparse ftruncate) to the bucket enclosing ``n_rows``;
+        returns True when capacity actually changed (the caller's cue to
+        refresh snapshots / re-pin resident uploads)."""
+        want = self._bucket(n_rows)
+        if want <= self.capacity:
+            return False
+        os.truncate(self.path, want * self._row_bytes)
+        self.capacity = want
+        self.rows = self._map()
+        return True
+
+    def flush(self) -> None:
+        self.rows.flush()
+
+
 def device_prefetch(batches, put, depth: int = 2):
     """Async double-buffered host->device feed: a background thread pulls
     host batches from ``batches`` and calls ``put`` (e.g.

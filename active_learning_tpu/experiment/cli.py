@@ -322,6 +322,12 @@ def main(argv: Optional[List[str]] = None):
     if argv and argv[0] == "serve":
         from ..serve.cli import main as serve_main
         return serve_main(argv[1:])
+    # ``stream``: the continual ingest -> score -> select service
+    # (active_learning_tpu/stream/, DESIGN.md §14) — serving-side ingest
+    # and the AL loop as one long-lived process on one persistent mesh.
+    if argv and argv[0] == "stream":
+        from ..stream.cli import main as stream_main
+        return stream_main(argv[1:])
     # ``status``: render a live run summary from heartbeat + metrics —
     # stdlib only, answers in milliseconds with NO jax import (it must
     # work from any shell against a wedged run).

@@ -392,11 +392,22 @@ DIAGNOSTICS_GAUGES = (
     "rd_pick_min_dist", "rd_pick_mean_dist", "rd_ece",
 )
 
+# The streaming service's per-round gauges (stream/service.py): ingest
+# volume, WAL backlog, trigger accounting, and ack-latency percentiles.
+# Flat names only — the per-cause trigger counters ride the
+# ``name{label=value}`` labeled-gauge convention (telemetry/prom.
+# gauge_samples) and are completeness-checked by tests/test_stream.py.
+STREAM_GAUGES = (
+    "ingest_rows_total", "ingest_labels_total", "pool_rows_total",
+    "wal_backlog_rows", "rounds_triggered_total", "ingest_ack_ms_p50",
+    "ingest_ack_ms_p99",
+)
+
 PER_ROUND_GAUGES = (
     "rd_round_time", "overlap_frac", "round_vs_max_phase",
     "rd_spec_score_time", "jit_cache_miss_delta", "fault_retries_total",
     "degrade_events", "hbm_peak_gb",
-) + DIAGNOSTICS_GAUGES
+) + DIAGNOSTICS_GAUGES + STREAM_GAUGES
 
 
 def _emit_round_gauges(telemetry, sink: MetricsSink, rd: int,

@@ -72,8 +72,18 @@ from typing import Any, Dict, Optional, Tuple
 #                 multichip learning probe gating --grad_allreduce
 #                 int8 (an injected failure = a broken probe; the run
 #                 must degrade to the f32 sync loudly, never crash)
+#   wal_write     stream/wal.IngestWAL.append — the ingest WAL's durable
+#                 append (torn point between the half-written line and
+#                 its completion: a kill there must replay as a dropped
+#                 never-acked record, not corruption)
+#   stream_drain  stream/service.StreamService._drain — applying queued
+#                 ingest records to the pool between rounds (a failure
+#                 here must crash the service BEFORE any round consumes
+#                 a half-applied pool; the WAL replay on restart loses
+#                 no accepted row)
 SITES = ("h2d_upload", "ckpt_write", "spec_scorer", "feed_worker",
-         "shard_upload", "dispatch", "grad_probe")
+         "shard_upload", "dispatch", "grad_probe", "wal_write",
+         "stream_drain")
 
 ACTIONS = ("raise", "oom", "die", "delay", "torn")
 

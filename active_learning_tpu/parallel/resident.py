@@ -343,6 +343,30 @@ def sharded_pool_gather(images, ids, mesh, labels=None):
         out_specs=(img_spec, P(axis)), check_rep=False)(images, labels, ids)
 
 
+def release(cache: Optional[Dict], dataset: Any) -> bool:
+    """Drop ``dataset``'s pinned entry (if any) so the NEXT access
+    re-uploads — the streaming subsystem's invalidation hook: an ingest
+    drain appends real rows into extent slots that were zero padding
+    when the pool was pinned, so the device copy is stale row-wise even
+    though its shape (the extent capacity) is unchanged.  Dropping the
+    entry costs one re-upload at the old shape; it never costs a
+    compile, because the gather runners are keyed on (step_fn, layout),
+    not on the array.  Returns True when an entry was actually
+    dropped."""
+    if not cache:
+        return False
+    images = getattr(dataset, "images", None)
+    if not isinstance(images, np.ndarray):
+        return False
+    key = (id(images), len(dataset))
+    with _CACHE_LOCK:
+        entry = cache.get("images", {}).pop(key, None)
+        lru = cache.get("lru", [])
+        if key in lru:
+            lru.remove(key)
+    return entry is not None
+
+
 def enforce_budget(cache: Optional[Dict], max_bytes: int) -> list:
     """Demote pinned pools, least-recently-used first, until the cache
     fits ``max_bytes`` — the clean-shrink path for an EXPLICIT budget

@@ -55,6 +55,13 @@ class PoolState:
       recent: indices labeled by the most recent ``update`` call.
       eval_idxs: validation indices carved out of the train set; never
         queryable (strategy.py:138,144).
+      invalid: bool[n_pool]; True for slots that hold NO real example —
+        the streaming subsystem (active_learning_tpu/stream/) grows the
+        pool by bucket_size-aligned extents so the resident-upload shape
+        ladder stays enumerable, and the padding slots between the valid
+        row count and the extent capacity are neither queryable, nor
+        labelable, nor eval.  A frozen-disk-pool experiment (the
+        reference protocol) never sets any of these.
       cumulative_cost: total budget spent so far.
       round: current AL round.
     """
@@ -66,6 +73,12 @@ class PoolState:
         default_factory=lambda: np.zeros(0, dtype=np.int64))
     cumulative_cost: float = 0.0
     round: int = 0
+    invalid: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, dtype=bool))
+
+    def __post_init__(self):
+        if self.invalid.size == 0 and self.n_pool:
+            self.invalid = np.zeros(self.n_pool, dtype=bool)
 
     @classmethod
     def create(cls, n_pool: int, eval_idxs: Sequence[int]) -> "PoolState":
@@ -78,11 +91,14 @@ class PoolState:
     # -- queries ---------------------------------------------------------
 
     def available_mask(self) -> np.ndarray:
-        """Bool mask of queryable examples: unlabeled and not in the eval
-        split (strategy.py:139-142)."""
+        """Bool mask of queryable examples: unlabeled, not in the eval
+        split (strategy.py:139-142), and not a padding/placeholder slot
+        (``invalid``)."""
         mask = ~self.labeled
         if self.eval_idxs.size:
             mask[self.eval_idxs] = False
+        if self.invalid.size:
+            mask &= ~self.invalid
         return mask
 
     def available_query_idxs(
@@ -146,9 +162,82 @@ class PoolState:
                     f"examples already labeled: {dup.tolist()}")
             if self.eval_idxs.size and np.isin(idxs, self.eval_idxs).any():
                 raise ValueError("query returned validation indices")
+            if self.invalid.size and self.invalid[idxs].any():
+                bad = idxs[self.invalid[idxs]][:10]
+                raise ValueError(
+                    f"query returned invalid (padding) slots: {bad.tolist()}")
             self.labeled[idxs] = True
         self.recent = idxs
         self.cumulative_cost += float(cost)
+
+    # -- streaming growth (active_learning_tpu/stream/) -------------------
+
+    def grow(self, n_pool: int) -> None:
+        """Extend the pool to ``n_pool`` slots.  New slots arrive INVALID
+        (padding) — ``set_valid`` opens them once real rows land in them.
+        Shrinking is refused: pool slots are append-only so index i means
+        the same example for the life of the experiment (the WAL/resume
+        contract of the streaming subsystem depends on it)."""
+        n_pool = int(n_pool)
+        if n_pool < self.n_pool:
+            raise ValueError(
+                f"pool cannot shrink ({self.n_pool} -> {n_pool}); slots "
+                "are append-only")
+        if n_pool == self.n_pool:
+            return
+        extra = n_pool - self.n_pool
+        self.labeled = np.concatenate(
+            [self.labeled, np.zeros(extra, dtype=bool)])
+        self.invalid = np.concatenate(
+            [self.invalid if self.invalid.size else
+             np.zeros(self.n_pool, dtype=bool),
+             np.ones(extra, dtype=bool)])
+        self.n_pool = n_pool
+
+    def set_valid(self, n_valid: int) -> None:
+        """Rows [0, n_valid) hold real examples; [n_valid, n_pool) stay
+        padding.  Monotone: a slot once valid never goes back."""
+        n_valid = int(n_valid)
+        if n_valid > self.n_pool:
+            raise ValueError(f"n_valid {n_valid} exceeds pool {self.n_pool}")
+        if self.invalid.size == 0:
+            self.invalid = np.zeros(self.n_pool, dtype=bool)
+        self.invalid[:n_valid] = False
+
+    def mark_valid(self, idxs: Sequence[int]) -> None:
+        """Open specific slots: real (oracle-labeled) rows just landed
+        in them — the streaming drain's per-extent validation."""
+        idxs = np.asarray(idxs, dtype=np.int64).reshape(-1)
+        if idxs.size:
+            self.invalid[idxs] = False
+
+    def mark_invalid(self, idxs: Sequence[int]) -> None:
+        """Mark specific slots as placeholders (e.g. ingested rows with
+        no oracle label yet — scoreable later, but not queryable)."""
+        idxs = np.asarray(idxs, dtype=np.int64).reshape(-1)
+        if idxs.size:
+            if self.labeled[idxs].any():
+                raise ValueError("cannot invalidate labeled slots")
+            self.invalid[idxs] = True
+
+    def absorb_labels(self, idxs: Sequence[int]) -> None:
+        """Mark externally-labeled rows (the streaming /v1/label path) as
+        labeled WITHOUT consuming budget or touching ``recent`` — these
+        rows were never queried; their labels arrived from outside the
+        loop.  Slots become valid as a side effect (a label IS the
+        missing oracle information)."""
+        idxs = np.asarray(idxs, dtype=np.int64).reshape(-1)
+        if idxs.size == 0:
+            return
+        if idxs.min() < 0 or idxs.max() >= self.n_pool:
+            raise ValueError(f"label indices out of range [0, {self.n_pool})")
+        if self.labeled[idxs].any():
+            dup = idxs[self.labeled[idxs]][:10]
+            raise ValueError(f"rows already labeled: {dup.tolist()}")
+        if self.eval_idxs.size and np.isin(idxs, self.eval_idxs).any():
+            raise ValueError("cannot attach labels to validation rows")
+        self.invalid[idxs] = False
+        self.labeled[idxs] = True
 
     # -- (de)serialization ----------------------------------------------
 
@@ -160,15 +249,22 @@ class PoolState:
             "recent": self.recent.copy(),
             "cumulative_cost": np.asarray(self.cumulative_cost),
             "round": np.asarray(self.round),
+            "invalid": (self.invalid.copy() if self.invalid.size else
+                        np.zeros(self.n_pool, dtype=bool)),
         }
 
     @classmethod
     def from_arrays(cls, arrs: dict) -> "PoolState":
+        n_pool = int(arrs["n_pool"])
+        # Pre-stream saves carry no invalid mask: all slots are real.
+        invalid = (np.array(arrs["invalid"], dtype=bool, copy=True)
+                   if "invalid" in arrs else np.zeros(n_pool, dtype=bool))
         return cls(
-            n_pool=int(arrs["n_pool"]),
+            n_pool=n_pool,
             labeled=np.array(arrs["labeled"], dtype=bool, copy=True),
             eval_idxs=np.array(arrs["eval_idxs"], dtype=np.int64, copy=True),
             recent=np.array(arrs["recent"], dtype=np.int64, copy=True),
             cumulative_cost=float(arrs["cumulative_cost"]),
             round=int(arrs["round"]),
+            invalid=invalid,
         )

@@ -1,0 +1,38 @@
+"""Streaming active learning: continual ingest -> score -> select as one
+long-lived service on the persistent mesh (DESIGN.md §14, ROADMAP item 3).
+
+The reference codebase and the paper both assume a frozen disk pool: the
+AL loop is an offline batch job over data that all exists at round 0.
+This package adds the run-indefinitely workload neither has — a process
+that admits new unlabeled rows and labels over HTTP (``POST /v1/pool``,
+``POST /v1/label``), re-scores the live pool incrementally, and fires
+full AL rounds through the existing driver phases whenever a trigger
+policy says so (new-row watermark, ``ServeScoreDrift`` PSI, or a max
+wall interval — whichever first).
+
+Module map:
+
+  wal.py        the fsync'd append-only ingest WAL — the durability
+                source of truth; written BEFORE the HTTP ack, replayed
+                idempotently on ``--resume_training``
+  ingest.py     HOST-PURE request handlers (closed ``_INGEST_HANDLERS``
+                registry; statically enforced by al_lint check 16
+                ``wal-before-ack``: no jax import, no ack before the
+                WAL append)
+  store.py      the growable candidate pool: memmap rows growing by
+                ``pool.bucket_size``-aligned extents so the resident
+                shape ladder stays enumerable
+  scheduler.py  the trigger policy (watermark / drift / interval)
+  server.py     the asyncio HTTP front end (serve/'s wire helpers,
+                413/429 admission semantics)
+  service.py    the long-lived loop: WAL replay -> bootstrap round ->
+                {probe drift, decide, drain, run one driver round}*
+  cli.py        the ``stream`` CLI verb
+
+jax enters only in service.py (scoring/rounds); everything the ingest
+ack path touches is numpy + stdlib, so the durability promise never
+waits on a device.
+"""
+
+from .scheduler import TriggerPolicy  # noqa: F401
+from .wal import IngestWAL, replay_wal  # noqa: F401

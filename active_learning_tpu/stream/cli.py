@@ -1,0 +1,84 @@
+"""The ``stream`` CLI verb.
+
+    python -m active_learning_tpu stream --dataset cifar10 \\
+        --strategy MarginSampler --round_budget 1000 \\
+        --stream_port 8008 --watermark_rows 2048 --drift_psi 0.25 \\
+        --max_interval_s 1800
+
+Every experiment flag of the batch CLI applies unchanged (the streaming
+loop runs the same driver phases over the same stack); the stream-
+specific flags configure the ingest listener, the WAL, and the trigger
+policy.  ``--rounds`` is ignored in favor of ``--max_rounds`` (0 = run
+indefinitely; SIGTERM checkpoint-and-exits and ``--resume_training``
+continues, replaying the ingest WAL so no accepted row is lost).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..config import StreamConfig
+
+
+def extend_parser(p):
+    g = p.add_argument_group("stream", "streaming-service flags")
+    g.add_argument("--stream_host", type=str, default="127.0.0.1")
+    g.add_argument("--stream_port", type=int, default=8008,
+                   help="ingest listener port; 0 = ephemeral (logged)")
+    g.add_argument("--max_request_rows", type=int, default=512,
+                   help="rows one POST /v1/pool may carry (413 above)")
+    g.add_argument("--max_backlog_rows", type=int, default=65536,
+                   help="accepted-but-undrained row bound (429 beyond)")
+    g.add_argument("--wal_rotate_bytes", type=int, default=64 << 20,
+                   help="ingest-WAL segment rotation bound")
+    g.add_argument("--watermark_rows", type=int, default=1024,
+                   help="trigger: pending new rows that fire a round "
+                        "(0 disables)")
+    g.add_argument("--drift_psi", type=float, default=0.25,
+                   help="trigger: ServeScoreDrift PSI of fresh-row "
+                        "scores vs the checkpoint baseline (0 disables)")
+    g.add_argument("--max_interval_s", type=float, default=3600.0,
+                   help="trigger: max wall seconds between rounds while "
+                        "any work remains (0 disables)")
+    g.add_argument("--stream_poll_s", type=float, default=0.5,
+                   help="scheduler poll cadence between rounds")
+    g.add_argument("--max_rounds", type=int, default=0,
+                   help="stop after this many total rounds; 0 = run "
+                        "indefinitely")
+    g.add_argument("--extent_floor", type=int, default=256,
+                   help="pool-growth extent floor (bucket_size floor)")
+    return p
+
+
+def args_to_stream_config(args) -> StreamConfig:
+    return StreamConfig(
+        host=args.stream_host, port=args.stream_port,
+        max_request_rows=args.max_request_rows,
+        max_backlog_rows=args.max_backlog_rows,
+        wal_rotate_bytes=args.wal_rotate_bytes,
+        watermark_rows=args.watermark_rows, drift_psi=args.drift_psi,
+        max_interval_s=args.max_interval_s, poll_s=args.stream_poll_s,
+        max_rounds=args.max_rounds, extent_floor=args.extent_floor)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from ..experiment.cli import args_to_config, get_parser
+    from ..faults.preempt import PreemptionRequested
+    from .service import run_stream
+
+    parser = extend_parser(get_parser())
+    parser.prog = "python -m active_learning_tpu stream"
+    args = parser.parse_args(argv)
+    cfg = args_to_config(args)
+    try:
+        run_stream(cfg, args_to_stream_config(args))
+    except PreemptionRequested:
+        # Graceful preemption: WAL + experiment state are durable and
+        # consistent — exit 0 so orchestrators treat it as clean;
+        # --resume_training continues with zero accepted-row loss.
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

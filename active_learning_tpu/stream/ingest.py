@@ -1,0 +1,264 @@
+"""Host-pure ingest handlers: validate -> WAL (fsync) -> queue -> ack.
+
+This module is the closed handler registry al_lint check 16
+(``wal-before-ack``) enforces two properties on:
+
+  1. **WAL before ack** — no handler may construct its ack before the
+     WAL append: the fsync inside ``IngestWAL.append`` is what makes
+     the ack a durability promise, and an ack built first could be
+     delivered by a code path that skips the write.
+  2. **Host purity** — no jax import anywhere here.  The ack path must
+     never wait on a device: admission, validation, the WAL fsync, and
+     the queue push are numpy + stdlib, so ingest latency is disk
+     latency, not dispatch latency.
+
+Handlers do NOT touch the pool store.  Accepted records go into the
+``PendingQueue``; the service thread drains it at round boundaries
+(stream/service.py), which keeps the pool's mutation order a pure
+function of WAL order + the round schedule — the property the
+bit-identical resume contract rides on.
+
+Admission semantics mirror serve/ (DESIGN.md §6): a request that could
+NEVER be admitted (too many rows for one request, malformed payload) is
+a 413/400 — non-retryable; a request the backlog can't take RIGHT NOW
+is a 429 with Retry-After — explicit backpressure, never unbounded
+queueing.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .store import decode_pool_payload
+
+# The closed registry: exactly the functions the HTTP front end may
+# route an ingest request to, and exactly the functions al_lint check 16
+# walks.  Appending here without satisfying the WAL-before-ack ordering
+# fails the tier-1 lint.
+_INGEST_HANDLERS = ("handle_pool_append", "handle_label_attach")
+
+# Lock discipline, statically enforced (scripts/al_lint.py
+# lock-discipline): the pending queue and id space are written by the
+# ingest server's executor threads and read by the service thread —
+# always under the owning object's _lock.
+_GUARDED_BY = {"_records": "_lock", "_pending_rows": "_lock",
+               "_pending_labels": "_lock", "_n_rows": "_lock"}
+
+# ONE total acceptance order.  WAL seq, acked pool ids, and queue
+# position are assigned in three different critical sections; without a
+# serializing lock two concurrent requests could interleave them (seq 1
+# acked with the ids of seq 2), and since replay applies records in SEQ
+# order the resumed pool would disagree with the ids the live service
+# promised.  Handlers hold this across admission + WAL append + id
+# extension + queue push, making all four orders the same order.  The
+# fsync inside append serializes on the disk anyway, so the lock costs
+# no real concurrency — and holding admission (reserve) inside it also
+# makes the backlog bound a hard bound instead of a racy check.
+_INGEST_ORDER_LOCK = threading.Lock()
+
+
+class IngestError(Exception):
+    """Maps 1:1 onto an HTTP error response (the front end translates).
+    ``retry_after``: set for backpressure (429) so compliant clients
+    pace themselves."""
+
+    def __init__(self, status: int, message: str,
+                 retry_after: Optional[int] = None):
+        super().__init__(message)
+        self.status = int(status)
+        self.message = message
+        self.retry_after = retry_after
+
+
+class PendingQueue:
+    """Accepted-but-not-yet-drained ingest records, in seq order.
+
+    The admission bound lives here: ``reserve`` is called by handlers
+    BEFORE the WAL write (a record the pool can't absorb must be
+    refused before it becomes durable), ``drain`` by the service thread
+    at round boundaries.  Rows are counted for pool records only —
+    label records are metadata-sized."""
+
+    def __init__(self, max_backlog_rows: int):
+        self.max_backlog_rows = int(max_backlog_rows)
+        self._lock = threading.Lock()
+        self._records: List[Dict[str, Any]] = []
+        self._pending_rows = 0
+        self._pending_labels = 0
+        self.accepted_rows_total = 0
+        self.accepted_labels_total = 0
+
+    @property
+    def pending_rows(self) -> int:
+        with self._lock:
+            return self._pending_rows
+
+    @property
+    def pending_records(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {"pending_rows": self._pending_rows,
+                    "pending_labels": self._pending_labels,
+                    "pending_records": len(self._records),
+                    "accepted_rows_total": self.accepted_rows_total,
+                    "accepted_labels_total": self.accepted_labels_total}
+
+    def reserve(self, n_rows: int) -> None:
+        """Admission check for ``n_rows`` more pool rows; raises the 429
+        IngestError when the backlog bound would be exceeded."""
+        with self._lock:
+            if self._pending_rows + n_rows > self.max_backlog_rows:
+                raise IngestError(
+                    429, f"ingest backlog at {self._pending_rows} rows; "
+                         f"admitting {n_rows} more would exceed the "
+                         f"{self.max_backlog_rows}-row bound — retry "
+                         "after the next round drains",
+                    retry_after=1)
+
+    def push(self, record: Dict[str, Any], n_rows: int,
+             n_labels: int) -> None:
+        with self._lock:
+            self._records.append(record)
+            self._pending_rows += n_rows
+            self._pending_labels += n_labels
+            self.accepted_rows_total += n_rows
+            self.accepted_labels_total += n_labels
+
+    def drain(self) -> List[Dict[str, Any]]:
+        """All pending records in acceptance order; resets the backlog
+        (service thread, round boundaries)."""
+        with self._lock:
+            records = self._records
+            self._records = []
+            self._pending_rows = 0
+            self._pending_labels = 0
+            return records
+
+    def snapshot_records(self) -> List[Dict[str, Any]]:
+        """A copy of the pending records WITHOUT draining — the service
+        thread's incremental drift probe reads rows through this."""
+        with self._lock:
+            return list(self._records)
+
+
+class IdSpace:
+    """The acked pool-id space: base rows + every accepted pool record,
+    BEFORE any of it is drained into the store.  Label requests validate
+    against this (a label for an id the service never acked is a 400),
+    and pool acks are computed from it — both without touching the
+    store, which the ingest thread must never read.
+
+    ``unlabelable``: ids that must never take an external label (the
+    eval split).  Rejected HERE, before the WAL write: a durable label
+    record the drain cannot absorb would replay into the same failure
+    on every restart — a poison pill no amount of recovery fixes."""
+
+    def __init__(self, n_rows: int, unlabelable=None):
+        self._lock = threading.Lock()
+        self._n_rows = int(n_rows)
+        self._unlabelable = frozenset(
+            int(i) for i in (unlabelable if unlabelable is not None
+                             else ()))
+
+    @property
+    def n_rows(self) -> int:
+        with self._lock:
+            return self._n_rows
+
+    def extend(self, n: int) -> Tuple[int, int]:
+        with self._lock:
+            start = self._n_rows
+            self._n_rows += int(n)
+            return start, self._n_rows
+
+    def validate_ids(self, ids: List[int]) -> None:
+        with self._lock:
+            n = self._n_rows
+        bad = [i for i in ids if not 0 <= i < n]
+        if bad:
+            raise IngestError(
+                400, f"label ids {bad[:10]} outside the acked pool "
+                     f"id space [0, {n})")
+        held = [i for i in ids if i in self._unlabelable]
+        if held:
+            raise IngestError(
+                400, f"label ids {held[:10]} are validation rows — the "
+                     "eval split never takes external labels")
+
+
+def ack_response(kind: str, seq: int, ids: List[int]) -> Dict[str, Any]:
+    """The success payload.  Constructed ONLY after the WAL append in
+    every handler (check 16's ordering rule keys on ack-named calls)."""
+    return {"ok": True, "kind": kind, "seq": seq,
+            "ids": [int(i) for i in ids], "accepted": len(ids)}
+
+
+def handle_pool_append(wal, queue: PendingQueue, ids: IdSpace,
+                       req: Dict[str, Any], image_shape,
+                       max_request_rows: int) -> Dict[str, Any]:
+    """POST /v1/pool: append unlabeled candidate rows.
+
+    Body: {"rows_b64"|"b64": ..., "shape": [n,h,w,c],
+           "labels": [...] optional oracle labels (simulated AL)}.
+    """
+    body = dict(req)
+    if "b64" in body and "rows_b64" not in body:
+        body["rows_b64"] = body.pop("b64")  # the serve wire spelling
+    try:
+        rows, labels = decode_pool_payload(body, image_shape)
+    except (KeyError, ValueError, TypeError) as e:
+        raise IngestError(400, f"invalid pool payload: {e}")
+    n = len(rows)
+    if n > max_request_rows:
+        raise IngestError(
+            413, f"request of {n} rows exceeds the service's "
+                 f"max_request_rows={max_request_rows}; split the "
+                 "request")
+    record = {"kind": "pool", "shape": [int(d) for d in rows.shape],
+              "rows_b64": body["rows_b64"],
+              "labels": list(labels) if labels is not None else None}
+    # One critical section for admission + durability + id assignment +
+    # queue position (see _INGEST_ORDER_LOCK): seq order == acked-id
+    # order == drain order == replay order.
+    with _INGEST_ORDER_LOCK:
+        queue.reserve(n)
+        # Durable BEFORE the ack: the fsync inside append is the promise.
+        seq = wal.append(record)
+        start, _end = ids.extend(n)
+        queue.push(dict(record, seq=seq), n_rows=n, n_labels=0)
+    return ack_response("pool", seq, list(range(start, start + n)))
+
+
+def handle_label_attach(wal, queue: PendingQueue, ids: IdSpace,
+                        req: Dict[str, Any]) -> Dict[str, Any]:
+    """POST /v1/label: attach labels to previously acked pool rows.
+    The rows join the labeled set at the next drain (no budget charged —
+    these labels arrived from outside the loop).
+
+    Body: {"ids": [...], "labels": [...]}.
+    """
+    row_ids = req.get("ids")
+    labels = req.get("labels")
+    if (not isinstance(row_ids, list) or not isinstance(labels, list)
+            or not row_ids or len(row_ids) != len(labels)
+            or not all(isinstance(i, int) and not isinstance(i, bool)
+                       for i in row_ids)
+            or not all(isinstance(v, int) and not isinstance(v, bool)
+                       and v >= 0 for v in labels)):
+        raise IngestError(
+            400, "label payload needs equal-length non-empty int lists "
+                 "'ids' and 'labels' (labels non-negative)")
+    if len(set(row_ids)) != len(row_ids):
+        raise IngestError(400, "duplicate ids in one label request")
+    record = {"kind": "label", "ids": list(row_ids),
+              "labels": list(labels)}
+    with _INGEST_ORDER_LOCK:
+        ids.validate_ids(row_ids)
+        seq = wal.append(record)
+        queue.push(dict(record, seq=seq), n_rows=0,
+                   n_labels=len(row_ids))
+    return ack_response("label", seq, row_ids)

@@ -1,0 +1,58 @@
+"""The trigger policy: when does the streaming loop fire an AL round?
+
+Three independent conditions, whichever fires first (DESIGN.md §14's
+trigger table):
+
+  watermark   enough NEW rows accepted since the last round — the
+              throughput trigger (amortize the round's fixed cost over
+              a worthwhile batch of candidates);
+  drift       the ``ServeScoreDrift`` PSI of freshly-ingested rows'
+              scores vs the checkpoint-time baseline crossed the
+              threshold — the DISTRIBUTION trigger (the model's view of
+              the incoming data moved, so the current picks/weights are
+              going stale regardless of volume).  This is the consumer
+              of the online drift signal PR 12 shipped;
+  interval    a max wall-clock bound so a trickle of rows (or a pool
+              with labeling budget left) still gets served — the
+              STALENESS backstop.  Gated on there being any work at all
+              (pending ingest or queryable rows): an exhausted, silent
+              pool must idle, not spin rounds that re-pick nothing.
+
+Pure host logic, zero jax, trivially unit-testable
+(tests/test_stream.py); the service evaluates it once per poll tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TriggerPolicy:
+    # Fire when this many new pool rows are pending (0 disables).
+    watermark_rows: int = 1024
+    # Fire when the ingest-score PSI vs the checkpoint baseline reaches
+    # this (0 disables).
+    drift_psi: float = 0.25
+    # Fire at most this long after the previous round, given any work
+    # (0 disables).
+    max_interval_s: float = 3600.0
+
+    def decide(self, pending_rows: int, pending_labels: int,
+               psi: Optional[float], since_last_round_s: float,
+               n_queryable: int) -> Optional[str]:
+        """The cause that fires now, or None.  Priority order is
+        watermark > drift > interval only for ATTRIBUTION (the journal
+        records one cause); any true condition fires the round."""
+        if 0 < self.watermark_rows <= pending_rows:
+            return "watermark"
+        if (self.drift_psi > 0 and psi is not None
+                and psi >= self.drift_psi):
+            return "drift"
+        if (self.max_interval_s > 0
+                and since_last_round_s >= self.max_interval_s
+                and (pending_rows > 0 or pending_labels > 0
+                     or n_queryable > 0)):
+            return "interval"
+        return None

@@ -1,0 +1,223 @@
+"""The streaming service's asyncio HTTP front end.
+
+Rides the serve stack's wire layer (serve/server.py's request parser,
+response writer, and error envelope are imported, not reimplemented) so
+the two online surfaces keep one HTTP dialect — same 400/413/429/503
+semantics, same keep-alive behavior, same JSON error bodies.
+
+Endpoints:
+
+  POST /v1/pool    append unlabeled rows ({"b64"|"rows_b64", "shape",
+                   optional "labels"}) -> {"ok", "seq", "ids"}
+  POST /v1/label   attach labels ({"ids", "labels"}) -> {"ok", "seq"}
+  GET  /healthz    liveness + pool shape (the loadgen reads
+                   ``image_shape`` here, exactly as it does from serve)
+  GET  /metrics    ingest counters + ack-latency percentiles + the
+                   live score-drift snapshot (JSON, or
+                   ``?format=prometheus`` through telemetry/prom)
+
+The handlers the POST routes call live in stream/ingest.py (the closed
+registry al_lint check 16 walks); this module only translates HTTP <->
+handler calls and records ack latency.  The WAL fsync runs inside the
+handler on this event-loop thread via ``run_in_executor`` — the loop
+keeps serving reads while a slow disk syncs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Tuple
+
+from . import ingest as ingest_lib
+from ..serve.metrics import ServeMetrics
+from ..serve.server import (_HttpError, _parse_json, _read_request,
+                            _write_response)
+from ..utils.logging import get_logger
+
+
+class StreamIngestServer:
+    """One listener bound to the service's host/port; handlers share the
+    service's WAL, pending queue, id space, and drift tracker."""
+
+    def __init__(self, wal, queue: ingest_lib.PendingQueue,
+                 ids: ingest_lib.IdSpace, image_shape,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_request_rows: int = 512, drift=None,
+                 metrics: Optional[ServeMetrics] = None,
+                 extra_status=None):
+        self.wal = wal
+        self.queue = queue
+        self.ids = ids
+        self.image_shape = tuple(image_shape)
+        self.host = host
+        self.cfg_port = int(port)
+        self.max_request_rows = int(max_request_rows)
+        self.drift = drift
+        self.metrics = metrics or ServeMetrics()
+        self.extra_status = extra_status or (lambda: {})
+        self.logger = get_logger()
+        self.port: Optional[int] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._draining = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._client, self.host, self.cfg_port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.logger.info(
+            f"stream: ingest listening on http://{self.host}:{self.port} "
+            f"(max_request_rows {self.max_request_rows}, backlog bound "
+            f"{self.queue.max_backlog_rows} rows)")
+
+    async def drain(self) -> None:
+        """Stop accepting; in-flight requests complete (each either got
+        its WAL fsync + ack or will answer 503)."""
+        if self._draining:
+            return
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self.logger.info("stream: ingest listener closed")
+
+    # -- connection handling ---------------------------------------------
+
+    async def _client(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    req = await _read_request(reader)
+                except _HttpError as e:
+                    _write_response(writer, e.status, {"error": e.message},
+                                    e.headers, keep_alive=False)
+                    await writer.drain()
+                    break
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if req is None:
+                    break
+                method, path, headers, body = req
+                loop = asyncio.get_running_loop()
+                t0 = loop.time()
+                status, payload, extra = await self._route(method, path,
+                                                           body)
+                rows = payload.pop("__rows__", 0) if isinstance(
+                    payload, dict) else 0
+                self.metrics.record_response(
+                    status, loop.time() - t0 if method == "POST" else None,
+                    rows=rows)
+                keep = (headers.get("connection", "").lower()
+                        != "close") and not self._draining
+                try:
+                    _write_response(writer, status, payload, extra,
+                                    keep_alive=keep)
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    break
+                if not keep:
+                    break
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001 - peer may already be gone
+                pass
+
+    async def _route(self, method: str, path: str, body: bytes
+                     ) -> Tuple[int, Dict, Dict[str, str]]:
+        path, _, query = path.partition("?")
+        try:
+            if method == "GET" and path == "/healthz":
+                return 200, self._healthz(), {}
+            if method == "GET" and path == "/metrics":
+                from urllib.parse import parse_qs
+                fmt = (parse_qs(query).get("format") or [""])[0]
+                if fmt == "prometheus":
+                    return 200, self._metrics_prometheus(), {
+                        "Content-Type":
+                            "text/plain; version=0.0.4; charset=utf-8"}
+                if fmt and fmt != "json":
+                    raise _HttpError(400, f"unknown metrics format "
+                                          f"{fmt!r}; use json or "
+                                          "prometheus")
+                return 200, self._metrics(), {}
+            if method == "POST" and path in ("/v1/pool", "/v1/label"):
+                self.metrics.record_request(path)
+                if self._draining:
+                    raise _HttpError(503, "service is draining")
+                req = _parse_json(body)
+                loop = asyncio.get_running_loop()
+                # The WAL fsync blocks; a worker thread keeps the loop
+                # serving reads.  The handlers' own locks (WAL, queue,
+                # id space) serialize acceptance order.
+                if path == "/v1/pool":
+                    out = await loop.run_in_executor(
+                        None, lambda: ingest_lib.handle_pool_append(
+                            self.wal, self.queue, self.ids, req,
+                            self.image_shape, self.max_request_rows))
+                else:
+                    out = await loop.run_in_executor(
+                        None, lambda: ingest_lib.handle_label_attach(
+                            self.wal, self.queue, self.ids, req))
+                out["__rows__"] = out.get("accepted", 0) \
+                    if path == "/v1/pool" else 0
+                return 200, out, {}
+            raise _HttpError(404, f"no route for {method} {path}")
+        except _HttpError as e:
+            return e.status, {"error": e.message}, e.headers
+        except ingest_lib.IngestError as e:
+            headers = ({"Retry-After": str(e.retry_after)}
+                       if e.retry_after is not None else {})
+            return e.status, {"error": e.message}, headers
+        except Exception as e:  # noqa: BLE001 - request isolation
+            self.logger.exception("stream: ingest request failed")
+            return 500, {"error": repr(e)}, {}
+
+    # -- views -----------------------------------------------------------
+
+    def _healthz(self) -> Dict:
+        return {
+            "ok": True,
+            "image_shape": list(self.image_shape),
+            "pool_rows": self.ids.n_rows,
+            "max_request_rows": self.max_request_rows,
+            "draining": self._draining,
+            **self.extra_status(),
+        }
+
+    def _metrics(self) -> Dict:
+        snap = self.metrics.snapshot()
+        snap["ingest"] = self.queue.counters()
+        snap["pool_rows"] = self.ids.n_rows
+        snap["wal_last_seq"] = self.wal.last_seq
+        if self.drift is not None:
+            snap["score_drift"] = self.drift.snapshot()
+        snap.update(self.extra_status())
+        return snap
+
+    def _metrics_prometheus(self) -> str:
+        from ..serve.metrics import prometheus_samples
+        from ..telemetry import prom
+        snap = self._metrics()
+        samples = prometheus_samples(snap)
+        ing = snap.get("ingest") or {}
+        samples += [
+            ("al_run_ingest_rows_total", None,
+             ing.get("accepted_rows_total")),
+            ("al_run_ingest_labels_total", None,
+             ing.get("accepted_labels_total")),
+            # Same spelling as the round-gauge channel (driver
+            # STREAM_GAUGES) and the docs: one quantity, ONE name.
+            ("al_run_wal_backlog_rows", None, ing.get("pending_rows")),
+            ("al_run_pool_rows_total", None, snap.get("pool_rows")),
+            ("al_run_wal_last_seq", None, snap.get("wal_last_seq")),
+        ]
+        lat = snap.get("latency_ms") or {}
+        for q, key in (("0.5", "p50"), ("0.99", "p99")):
+            if lat.get(key) is not None:
+                samples.append(("al_run_ingest_ack_latency_ms",
+                                {"quantile": q}, lat[key]))
+        return prom.render(samples)

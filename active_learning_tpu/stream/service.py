@@ -1,0 +1,647 @@
+"""The streaming service: serving-side ingest and the AL loop as ONE
+long-lived process on one persistent mesh (DESIGN.md §14).
+
+Lifecycle:
+
+  1. replay the ingest WAL (accepted-but-undrained records re-enter the
+     pending queue — a mid-ingest kill loses nothing acked);
+  2. build the experiment stack through the SAME wiring the batch
+     driver uses (experiment/driver.build_experiment), over growable
+     datasets backed by the pool store;
+  3. open the ingest HTTP listener (own asyncio thread; handlers are
+     host-pure and never touch the pool);
+  4. loop: probe drift over freshly-ingested rows (incremental,
+     chunk-aligned — scoring.chunk_row_slices), ask the trigger policy,
+     and when it fires DRAIN the queue into the pool (the only place
+     the pool mutates — pool state is a pure function of WAL order +
+     the round schedule) and run ONE full AL round through the driver's
+     phases, round journal, degradation ladder, and SIGTERM
+     checkpoint-and-exit.
+
+Round bodies deliberately mirror experiment/driver._run_round verb for
+verb (query -> update -> init -> train -> load_best -> test -> save):
+a stream run with zero ingest produces an ``experiment_state`` BIT-
+IDENTICAL to the batch driver at the same seeds (pinned in
+tests/test_stream.py), which is what makes every batch-mode claim
+(resume, ladder, pipelining) carry over to the streaming loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import faults
+from ..config import ExperimentConfig, StreamConfig, TrainConfig, \
+    config_to_dict
+from ..data.core import ArrayDataset
+from ..experiment import pipeline as pipeline_lib
+from ..experiment import resume as resume_lib
+from ..experiment.driver import (_emit_round_gauges, _emit_round_telemetry,
+                                 _labeled_crc, _restore_round_snapshot,
+                                 _round_snapshot, build_experiment,
+                                 enable_compilation_cache)
+from ..faults import ladder as ladder_lib
+from ..faults import preempt as preempt_lib
+from ..parallel import mesh as mesh_lib
+from ..parallel import resident as resident_lib
+from ..serve.metrics import ServeMetrics
+from ..strategies import scoring
+from ..telemetry import diagnostics as diag_lib
+from ..telemetry import runtime as tele_runtime
+from ..telemetry import spans as tele_spans
+from ..utils.logging import get_logger, setup_logging
+from ..utils.metrics import MetricsSink, make_sink
+from ..utils.tracing import phase_timer
+from . import ingest as ingest_lib
+from . import store as store_lib
+from .scheduler import TriggerPolicy
+from .server import StreamIngestServer
+from .wal import IngestWAL, iter_payloads, replay_wal
+
+WAL_DIR = "ingest_wal"
+POOL_DIR = "stream_pool"
+
+
+class StreamService:
+    """One streaming experiment.  ``run()`` blocks until ``max_rounds``
+    complete (or forever when 0), raising PreemptionRequested on
+    SIGTERM/SIGINT exactly like the batch driver — the CLI maps it to
+    exit 0."""
+
+    def __init__(self, cfg: ExperimentConfig, stream_cfg: StreamConfig,
+                 sink: Optional[MetricsSink] = None, data=None,
+                 train_cfg: Optional[TrainConfig] = None, model=None):
+        self.cfg = cfg
+        self.stream_cfg = stream_cfg
+        self._sink = sink
+        self._data = data
+        self._train_cfg = train_cfg
+        self._model = model
+        self.logger = get_logger()
+        # Populated by run(); tests read them.
+        self.strategy = None
+        self.store: Optional[store_lib.PoolStore] = None
+        self.wal: Optional[IngestWAL] = None
+        self.queue: Optional[ingest_lib.PendingQueue] = None
+        self.drift: Optional[diag_lib.ServeScoreDrift] = None
+        self.server: Optional[StreamIngestServer] = None
+        self.port: Optional[int] = None
+        self.ready = threading.Event()  # listener up, loop entered
+        self.rounds_run = 0
+        self.last_trigger: Dict = {"cause": None, "ts": None}
+        self._cause_counts: Dict[str, int] = {}
+        self._probed_rows = 0
+        self._loop_thread: Optional[threading.Thread] = None
+        self._aio: Optional[asyncio.AbstractEventLoop] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def run(self):
+        cfg, scfg = self.cfg, self.stream_cfg
+        mesh_lib.initialize_distributed(cfg.coordinator_address,
+                                        cfg.num_processes, cfg.process_id)
+        enable_compilation_cache(cfg.compilation_cache_dir)
+        fault_spec = cfg.fault_spec or os.environ.get("AL_FAULT_SPEC")
+        if fault_spec:
+            faults.configure(fault_spec, seed=cfg.run_seed)
+        if cfg.exp_hash is None:
+            cfg.exp_hash = uuid.uuid4().hex[:9]
+        logger = setup_logging(
+            cfg.log_dir, f"stream_{cfg.exp_hash}_{os.getpid()}.log")
+        self.logger = logger
+
+        resuming = cfg.resume_training and \
+            resume_lib.has_saved_experiment(cfg)
+        preempted_round0 = False
+        if cfg.resume_training and not resuming:
+            # Mirror the driver's round-0 preemption rule: a journaled
+            # round-0 preemption of THIS experiment replays round 0;
+            # anything else refuses rather than silently restarting.
+            # The PRIOR journal must be read before this run's journal
+            # writes anything — a merge-writer starts from empty fields,
+            # so the first write would clobber the preemption record.
+            prior = faults.read_journal(
+                os.path.join(cfg.log_dir, faults.JOURNAL_FILE))
+            if (prior is not None and prior.get("status") == "preempted"
+                    and prior.get("exp_hash") == cfg.exp_hash
+                    and prior.get("exp_name") == cfg.exp_name
+                    and int(prior.get("round", -1)) == 0):
+                preempted_round0 = True
+            else:
+                raise FileNotFoundError(
+                    f"--resume_training: no saved experiment state for "
+                    f"exp_name={cfg.exp_name!r} exp_hash={cfg.exp_hash!r} "
+                    f"under {cfg.ckpt_path!r}; pass the original "
+                    "--exp_hash/--ckpt_path")
+        journal = faults.RoundJournal(
+            os.path.join(cfg.log_dir, faults.JOURNAL_FILE),
+            enabled=mesh_lib.is_coordinator())
+        journal.write(exp_name=cfg.exp_name, exp_hash=cfg.exp_hash,
+                      stream=True)
+        if self._sink is None:
+            key = (resume_lib.saved_experiment_key(cfg) if resuming
+                   else cfg.exp_hash)
+            self._sink = make_sink(
+                cfg.enable_metrics and mesh_lib.is_coordinator(),
+                cfg.log_dir, experiment_key=key,
+                backend=cfg.metrics_backend,
+                rotate_bytes=cfg.metrics_rotate_bytes)
+        sink = self._sink
+
+        telemetry = tele_runtime.start_run(
+            cfg.telemetry, log_dir=cfg.log_dir, logger=logger)
+        status = "crashed"
+        pipeline = None
+        preempt_lib.reset()
+        prev_handlers = preempt_lib.install(logger)
+        run_retries0 = faults.retry_counters()["total"]
+        try:
+            start_round = self._build(journal, resuming, preempted_round0)
+            strategy = self.strategy
+            pipeline_mode = pipeline_lib.resolve_round_pipeline(
+                cfg.round_pipeline, strategy.mesh)
+            if pipeline_mode == "speculative":
+                pipeline = pipeline_lib.RoundPipeline(strategy)
+                strategy.pipeline = pipeline
+            logger.info(f"Round pipeline: {pipeline_mode}")
+            ladder = ladder_lib.DegradationLadder(strategy, logger=logger,
+                                                  sink=sink,
+                                                  journal=journal)
+            save_retry = faults.RetryPolicy(
+                site="experiment_save", classify=faults.classify_exception)
+            self._serve_start()
+            self.ready.set()
+            self._loop(start_round, journal, telemetry, sink, ladder,
+                       save_retry, run_retries0)
+            status = "finished"
+            journal.write(status="finished")
+            return strategy
+        except preempt_lib.PreemptionRequested as exc:
+            status = "preempted"
+            journal.write(status="preempted", signal=int(exc.signum))
+            logger.info(
+                "stream: preemption — WAL + experiment state durable; "
+                "re-run with --resume_training to continue")
+            raise
+        finally:
+            self._serve_stop()
+            if self.wal is not None:
+                self.wal.close()
+            if self.store is not None:
+                self.store.flush()
+            if fault_spec:
+                faults.configure(None)
+            preempt_lib.uninstall(prev_handlers)
+            if pipeline is not None:
+                pipeline.shutdown()
+            telemetry.finish(status)
+            tele_runtime.uninstall(telemetry)
+
+    # -- construction -----------------------------------------------------
+
+    def _build(self, journal, resuming: bool,
+               preempted_round0: bool) -> int:
+        cfg, scfg = self.cfg, self.stream_cfg
+        if self._train_cfg is None:
+            from ..experiment import arg_pools as arg_pools_lib
+            self._train_cfg = arg_pools_lib.get_train_config(
+                cfg.arg_pool, cfg.dataset,
+                pretrained_root=cfg.pretrained_root)
+        if self._data is None:
+            from ..data import get_data
+            self._data = get_data(cfg.dataset, data_path=cfg.dataset_dir,
+                                  debug_mode=cfg.debug_mode,
+                                  imbalance_args=cfg.imbalance,
+                                  download=cfg.download_data)
+        base_train, test_set, base_al = self._data
+        images = getattr(base_train, "images", None)
+        if not isinstance(images, np.ndarray):
+            raise ValueError(
+                "stream: the base dataset must be in-memory (images "
+                "array) — disk-backed base pools are future work "
+                "(DESIGN.md §14)")
+        n_base = len(base_train)
+        self.store = store_lib.PoolStore(
+            os.path.join(cfg.log_dir, POOL_DIR), base_train.image_shape,
+            base_al.num_classes, base_images=images[:n_base],
+            base_targets=base_train.targets[:n_base],
+            extent_floor=scfg.extent_floor)
+        # Build-time datasets span the BASE rows only: the eval split
+        # and init pool are seeded over data round 0 of ANY timeline
+        # can see, so every ingest schedule shares them.
+        self._train_sd, self._al_sd = self.store.make_datasets(
+            base_train.view, base_al.view, length=n_base)
+
+        # WAL replay BEFORE the strategy exists: replayed records enter
+        # the pending queue and drain at the next round start exactly
+        # like live ingest — a mid-ingest kill loses no accepted row.
+        wal_dir = os.path.join(cfg.log_dir, WAL_DIR)
+        records, dropped = replay_wal(wal_dir)
+        if dropped:
+            self.logger.info(
+                f"stream: WAL replay dropped {dropped} torn un-acked "
+                "tail record")
+        # The appender reuses this replay (one full-WAL read per start).
+        self.wal = IngestWAL(wal_dir, rotate_bytes=scfg.wal_rotate_bytes,
+                             replayed=records)
+        self.queue = ingest_lib.PendingQueue(scfg.max_backlog_rows)
+        replayed_rows = 0
+        for rec in iter_payloads(records):
+            if rec.get("kind") == "pool":
+                n = int(rec["shape"][0])
+                self.queue.push(rec, n_rows=n, n_labels=0)
+                replayed_rows += n
+            else:
+                self.queue.push(rec, n_rows=0,
+                                n_labels=len(rec.get("ids", ())))
+        if records:
+            self.logger.info(
+                f"stream: replayed {len(records)} WAL records "
+                f"({replayed_rows} pool rows) into the pending queue")
+
+        strategy = build_experiment(
+            cfg, sink=self._sink,
+            data=(self._train_sd, test_set, self._al_sd),
+            train_cfg=self._train_cfg, model=self._model,
+            skip_init_pool=resuming)
+        self.strategy = strategy
+        # The acked-id space the handlers validate against: base + every
+        # replayed pool row, with the eval split unlabelable — a label
+        # the drain could never absorb must be a 400 BEFORE the WAL
+        # write, or it would replay into the same failure forever.
+        self.ids = ingest_lib.IdSpace(n_base + replayed_rows,
+                                      unlabelable=strategy.pool.eval_idxs)
+        self.drift = diag_lib.ServeScoreDrift(key="margin")
+        if resuming:
+            start_round = resume_lib.load_experiment(strategy, cfg)
+            strategy.resume_next_fit = True
+            # The restored pool may already span extents a previous
+            # segment drained; the datasets must present that capacity
+            # (the store itself refills at the first drain's replay).
+        else:
+            start_round = 0
+            self._sink.log_parameters(config_to_dict(cfg))
+            if preempted_round0:
+                self.logger.info(
+                    "stream resume: journal records a round-0 "
+                    "preemption; replaying round 0 with its mid-fit "
+                    "state")
+                strategy.resume_next_fit = True
+        return start_round
+
+    # -- ingest listener (asyncio thread) ---------------------------------
+
+    def _serve_start(self) -> None:
+        scfg = self.stream_cfg
+        self.metrics = ServeMetrics()
+        self.server = StreamIngestServer(
+            self.wal, self.queue, self.ids, self.store.image_shape,
+            host=scfg.host, port=scfg.port,
+            max_request_rows=scfg.max_request_rows, drift=self.drift,
+            metrics=self.metrics, extra_status=self._status_fields)
+        self._aio = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=lambda: (asyncio.set_event_loop(self._aio),
+                            self._aio.run_forever()),
+            daemon=True, name="al-stream-ingest-loop")
+        self._loop_thread.start()
+        asyncio.run_coroutine_threadsafe(
+            self.server.start(), self._aio).result(60)
+        self.port = self.server.port
+
+    def _serve_stop(self) -> None:
+        if self._aio is None:
+            return
+        try:
+            if self.server is not None:
+                asyncio.run_coroutine_threadsafe(
+                    self.server.drain(), self._aio).result(30)
+        finally:
+            self._aio.call_soon_threadsafe(self._aio.stop)
+            if self._loop_thread is not None:
+                self._loop_thread.join(timeout=10)
+            self._aio = None
+
+    def _status_fields(self) -> Dict:
+        return {
+            "stream": {
+                "rounds_run": self.rounds_run,
+                "last_trigger_cause": self.last_trigger["cause"],
+                "last_trigger_ts": self.last_trigger["ts"],
+            }
+        }
+
+    # -- the trigger loop -------------------------------------------------
+
+    def _loop(self, start_round: int, journal, telemetry, sink, ladder,
+              save_retry, run_retries0) -> None:
+        cfg, scfg = self.cfg, self.stream_cfg
+        strategy = self.strategy
+        policy = TriggerPolicy(watermark_rows=scfg.watermark_rows,
+                               drift_psi=scfg.drift_psi,
+                               max_interval_s=scfg.max_interval_s)
+        last_round_t = time.monotonic()
+        rd = start_round
+        last_journal_t = 0.0
+        with tele_spans.get_tracer().span(
+                "experiment", args={"exp_name": cfg.exp_name,
+                                    "exp_hash": cfg.exp_hash,
+                                    "stream": True}):
+            while True:
+                preempt_lib.check()
+                if scfg.max_rounds and rd >= scfg.max_rounds:
+                    self.logger.info(
+                        f"stream: max_rounds={scfg.max_rounds} reached")
+                    return
+                counters = self.queue.counters()
+                if rd == 0:
+                    # Bootstrap: the first round needs no trigger — a
+                    # model must exist before scores (and drift) can.
+                    cause = "bootstrap"
+                else:
+                    self._probe_drift()
+                    psi = self.drift.snapshot().get("psi")
+                    cause = policy.decide(
+                        counters["pending_rows"],
+                        counters["pending_labels"], psi,
+                        time.monotonic() - last_round_t,
+                        int(strategy.pool.available_mask().sum()))
+                now = time.monotonic()
+                if cause is None:
+                    telemetry.tick(phase="stream_wait",
+                                   round=rd - 1 if rd else 0)
+                    # Idle journal cadence is bounded below: an idle
+                    # service must not rewrite the journal 20x/s just
+                    # because the trigger poll is fast.
+                    if now - last_journal_t >= max(scfg.poll_s, 2.0):
+                        self._journal_stream(journal, counters)
+                        last_journal_t = now
+                    time.sleep(scfg.poll_s)
+                    continue
+                self.logger.info(
+                    f"stream: round {rd} triggered by {cause} "
+                    f"(backlog {counters['pending_rows']} rows, "
+                    f"{counters['pending_labels']} labels)")
+                self._drain()
+                ladder.relax(rd)
+                snapshot = _round_snapshot(strategy)
+                for attempt in range(ladder.max_attempts()):
+                    try:
+                        self._run_round(rd, attempt, cause, journal,
+                                        telemetry, sink, ladder,
+                                        save_retry)
+                        break
+                    except preempt_lib.PreemptionRequested:
+                        raise
+                    except ladder_lib.DegradeRequested as exc:
+                        if ladder.escalate(exc, rd) is None:
+                            raise
+                        _restore_round_snapshot(strategy, snapshot, rd)
+                    except (Exception, faults.ThreadDeath) as exc:
+                        if strategy.pipeline is not None:
+                            strategy.pipeline.disarm()
+                        if ladder.escalate(exc, rd) is None:
+                            raise
+                        _restore_round_snapshot(strategy, snapshot, rd)
+                _emit_round_telemetry(telemetry, sink, rd, strategy,
+                                      ladder,
+                                      retries_baseline=run_retries0)
+                self._emit_stream_gauges(telemetry, sink, rd, cause)
+                # What the outgoing checkpoint scored over its ingest
+                # window becomes the drift reference for the new one —
+                # the ServeScoreDrift hot-reload semantics, driven by
+                # round completion instead of a file watcher.
+                self.drift.rebaseline(rd)
+                self.rounds_run += 1
+                self._cause_counts[cause] = \
+                    self._cause_counts.get(cause, 0) + 1
+                self.last_trigger = {"cause": cause, "ts": time.time()}
+                self._journal_stream(journal, self.queue.counters())
+                last_round_t = time.monotonic()
+                rd += 1
+                if int(strategy.pool.available_mask().sum()) == 0 \
+                        and scfg.max_rounds == 0 \
+                        and self.queue.pending_rows == 0:
+                    self.logger.info(
+                        "stream: pool exhausted and backlog empty — "
+                        "idling for new rows")
+
+    # -- one round (the driver's loop body, verb for verb) ----------------
+
+    def _run_round(self, rd: int, attempt: int, cause: str, journal,
+                   telemetry, sink, ladder, save_retry) -> None:
+        cfg = self.cfg
+        strategy = self.strategy
+        init_pool_size = cfg.resolved_init_pool_size()
+        with tele_spans.get_tracer().span(
+                "round", args={"round": rd, "attempt": attempt,
+                               "cause": cause}):
+            strategy.round = rd
+            telemetry.tick(force=True, round=rd, phase="round_start",
+                           epoch=0, step=0)
+            journal.write(status="running", round=rd, phase="round_start",
+                          attempt=attempt,
+                          labeled=strategy.pool.num_labeled,
+                          labeled_crc=_labeled_crc(strategy.pool),
+                          degrade=list(ladder.active),
+                          pipeline_armed=bool(strategy.pipeline),
+                          stream_trigger_cause=cause)
+            self.logger.info(f"Active Learning Round {rd} start "
+                             f"(stream, cause={cause}).")
+            strategy.trainer.refresh_resident_budget()
+            al_round_0 = rd == 0 and init_pool_size == 0
+            if rd > 0 or al_round_0:
+                if al_round_0:
+                    strategy.init_network_weights()
+                with phase_timer("query_time", rd, sink, self.logger):
+                    labeled_idxs, cur_cost = strategy.query(
+                        cfg.round_budget)
+                strategy.update(labeled_idxs, cur_cost)
+                self._boundary(rd, "query", journal, ladder)
+            with phase_timer("init_network_weights_time", rd, sink,
+                             self.logger):
+                strategy.init_network_weights()
+            self._boundary(rd, "init", journal, ladder)
+            if strategy.pipeline is not None and (
+                    self.stream_cfg.max_rounds == 0
+                    or rd + 1 < self.stream_cfg.max_rounds):
+                strategy.pipeline.arm(rd)
+            with phase_timer("train_time", rd, sink, self.logger):
+                strategy.train()
+            self._boundary(rd, "train", journal, ladder)
+            with phase_timer("load_best_ckpt_time", rd, sink,
+                             self.logger):
+                strategy.load_best_ckpt()
+            with phase_timer("test_time", rd, sink, self.logger):
+                strategy.test()
+            if mesh_lib.is_coordinator():
+                save_retry.call(resume_lib.save_experiment, strategy, cfg)
+            cfg.resume_training = True
+            journal.write(round=rd, phase="round_end",
+                          labeled=strategy.pool.num_labeled,
+                          labeled_crc=_labeled_crc(strategy.pool))
+
+    def _boundary(self, rd: int, phase: str, journal, ladder) -> None:
+        journal.write(round=rd, phase=phase)
+        preempt_lib.check()
+        ladder.check_stall()
+
+    # -- drain: the ONLY pool mutation point ------------------------------
+
+    def _drain(self) -> int:
+        """Apply every pending ingest record to the store + pool state,
+        in WAL order.  Idempotent under resume replay: rows the restored
+        pool already counts re-validate in place, labels it already
+        absorbed are skipped.  Returns the number of appended rows."""
+        records = self.queue.drain()
+        if not records:
+            return 0
+        faults.site("stream_drain")
+        strategy = self.strategy
+        pool = strategy.pool
+        appended = 0
+        oracle_ids = []
+        label_batches = []
+        for rec in records:
+            if rec.get("kind") == "pool":
+                ids = self.store.apply_pool_record(rec)
+                appended += len(ids)
+                if rec.get("labels") is not None:
+                    oracle_ids.append(ids)
+            else:
+                label_batches.append(self.store.apply_label_record(rec))
+        # The device copy (rows AND labels) is stale the moment records
+        # land: drop the pinned entry so the round re-uploads.  Same
+        # extent shape -> re-upload only, zero compiles (pinned in
+        # tests/test_compile_reuse.py).
+        resident_lib.release(strategy.trainer.resident_pool, self._al_sd)
+        resident_lib.release(strategy.trainer.resident_pool,
+                             self._train_sd)
+        if appended:
+            pool.grow(self.store.capacity)
+            for ids in oracle_ids:
+                pool.mark_valid(ids)
+            self._al_sd.refresh()
+            self._train_sd.refresh()
+        for ids, _labels in label_batches:
+            fresh = ids[~pool.labeled[ids]]
+            # Defense in depth behind the handler's 400 guard: a WAL
+            # label record the pool cannot absorb (a record written
+            # before the eval-split validation existed, say) must
+            # degrade to a logged skip — raising here would crash-loop
+            # the service on every replay of the same record.
+            if pool.eval_idxs.size:
+                held = fresh[np.isin(fresh, pool.eval_idxs)]
+                if held.size:
+                    self.logger.warning(
+                        f"stream: skipping {held.size} label(s) naming "
+                        "validation rows (un-absorbable; the handler "
+                        "now rejects these before the WAL)")
+                    fresh = fresh[~np.isin(fresh, pool.eval_idxs)]
+            if len(fresh):
+                pool.absorb_labels(fresh)
+        self._probed_rows = 0
+        self.logger.info(
+            f"stream: drained {len(records)} records — {appended} rows "
+            f"appended (pool {self.store.n_rows}/{self.store.capacity} "
+            f"rows/capacity), {sum(len(i) for i, _ in label_batches)} "
+            "labels attached")
+        return appended
+
+    # -- incremental drift scoring ----------------------------------------
+
+    def _probe_drift(self) -> None:
+        """Score rows ingested since the last probe with the CURRENT
+        best weights and fold them into the live drift histogram — the
+        consumer of the ServeScoreDrift signal.  Incremental and
+        chunk-aligned: only new rows are scored, in chunk_row_slices
+        plans, so splice(chunks) == the monolithic pass bit for bit
+        (the PR 7 contract, extended to appended extents).  Consumes no
+        rng — probing can never perturb the round chain."""
+        strategy = self.strategy
+        if strategy is None or strategy.state is None:
+            return
+        rows = self._pending_pool_rows(self._probed_rows)
+        if rows is None or len(rows) == 0:
+            return
+        ds = ArrayDataset(rows, np.zeros(len(rows), dtype=np.int64),
+                          strategy.num_classes, self._al_sd.view)
+        bs = strategy._score_batch_size()
+        step = strategy._get_score_step("prob_stats")
+        chunks = []
+        idxs = np.arange(len(rows), dtype=np.int64)
+        for sl in scoring.chunk_row_slices(
+                len(rows), bs, self.stream_cfg.chunk_batches):
+            chunks.append(scoring.collect_pool(
+                ds, idxs[sl], bs, step, strategy.state.variables,
+                strategy.mesh, keys=("margin",),
+                dispatch_lock=strategy.trainer.dispatch_lock))
+        out = scoring.splice_chunks(chunks)
+        self.drift.observe(out["margin"])
+        self._probed_rows += len(rows)
+
+    def _pending_pool_rows(self, skip: int) -> Optional[np.ndarray]:
+        """Decoded pending pool rows past the first ``skip`` (the rows
+        already probed this drain window)."""
+        records = self.queue.snapshot_records()
+        rows = []
+        seen = 0
+        for rec in records:
+            if rec.get("kind") != "pool":
+                continue
+            n = int(rec["shape"][0])
+            if seen + n <= skip:
+                seen += n
+                continue
+            decoded, _ = store_lib.decode_pool_payload(
+                rec, self.store.image_shape)
+            rows.append(decoded[max(0, skip - seen):])
+            seen += n
+        if not rows:
+            return None
+        return np.concatenate(rows, axis=0)
+
+    # -- observability ----------------------------------------------------
+
+    def _journal_stream(self, journal, counters: Dict) -> None:
+        journal.write(
+            stream_pool_rows=self.store.n_rows,
+            stream_wal_backlog=counters["pending_rows"],
+            stream_wal_seq=self.wal.last_seq,
+            stream_rounds_run=self.rounds_run,
+            stream_last_trigger_cause=self.last_trigger["cause"],
+            stream_last_trigger_ts=self.last_trigger["ts"])
+
+    def _emit_stream_gauges(self, telemetry, sink, rd: int,
+                            cause: str) -> None:
+        counters = self.queue.counters()
+        lat = self.metrics.snapshot().get("latency_ms") or {}
+        cause_count = self._cause_counts.get(cause, 0) + 1
+        gauges = {
+            "ingest_rows_total": counters["accepted_rows_total"],
+            "ingest_labels_total": counters["accepted_labels_total"],
+            "pool_rows_total": self.store.n_rows,
+            "wal_backlog_rows": counters["pending_rows"],
+            "rounds_triggered_total": self.rounds_run + 1,
+            f"rounds_triggered{{cause={cause}}}": cause_count,
+            "ingest_ack_ms_p50": lat.get("p50"),
+            "ingest_ack_ms_p99": lat.get("p99"),
+        }
+        _emit_round_gauges(telemetry, sink, rd, gauges)
+        telemetry.write_prometheus()
+
+
+def run_stream(cfg: ExperimentConfig, stream_cfg: StreamConfig,
+               sink: Optional[MetricsSink] = None, data=None,
+               train_cfg: Optional[TrainConfig] = None, model=None):
+    """Build + run one streaming service; returns the Strategy (the
+    programmatic mirror of the ``stream`` CLI verb)."""
+    return StreamService(cfg, stream_cfg, sink=sink, data=data,
+                         train_cfg=train_cfg, model=model).run()

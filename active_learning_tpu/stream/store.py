@@ -1,0 +1,213 @@
+"""The growable candidate pool behind the streaming service.
+
+``PoolStore`` owns two ``data/cache.GrowableRowStore`` memmaps (uint8
+image rows + int64 targets) seeded from the base dataset and grown by
+``pool.bucket_size``-aligned extents as ingest records are applied.
+Targets of rows whose oracle label is unknown hold ``UNKNOWN_LABEL``;
+such rows are scoreable but not queryable until ``/v1/label`` attaches
+their label (PoolState's ``invalid`` mask carries that distinction).
+
+``StreamDataset`` is the Dataset view the Strategy/Trainer stack
+consumes.  It reads a SNAPSHOT (rows memmap ref, targets ref, length)
+taken at the last ingest drain, so a round in flight never observes
+mid-round growth: the ingest thread appends to the store, but the
+datasets the round is scoring/training over are frozen until the
+service's next drain calls ``refresh()``.  Because growth is ftruncate
+(data/cache.py), the snapshot's mapping stays valid even while the file
+grows underneath it.
+
+Thread contract: ``apply_pool_record``/``apply_label_record``/
+``refresh`` run on the SERVICE thread only (drain points); the ingest
+thread never touches the store — handlers queue records
+(stream/ingest.py), which is what makes the pool's mutation order a
+pure function of WAL order and the round schedule (the bit-identical
+resume contract).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..data.cache import GrowableRowStore
+from ..data.core import Dataset, ViewSpec
+
+UNKNOWN_LABEL = -1
+
+
+class PoolStore:
+    def __init__(self, directory: str, image_shape: Tuple[int, int, int],
+                 num_classes: int,
+                 base_images: Optional[np.ndarray] = None,
+                 base_targets: Optional[np.ndarray] = None,
+                 extent_floor: int = 256):
+        self.image_shape = tuple(int(d) for d in image_shape)
+        self.num_classes = int(num_classes)
+        n0 = len(base_images) if base_images is not None else 0
+        self._rows = GrowableRowStore(
+            os.path.join(directory, "pool_rows.u8"), self.image_shape,
+            dtype=np.uint8, capacity=n0, extent_floor=extent_floor)
+        self._targets = GrowableRowStore(
+            os.path.join(directory, "pool_targets.i64"), (),
+            dtype=np.int64, capacity=n0, extent_floor=extent_floor)
+        self.n_rows = 0
+        self.n_base = 0
+        if base_images is not None:
+            assert base_images.dtype == np.uint8
+            self._rows.rows[:n0] = base_images[:n0]
+            self._targets.rows[:n0] = np.asarray(base_targets,
+                                                 dtype=np.int64)[:n0]
+            self.n_rows = self.n_base = n0
+        # Fresh capacity slots are zero-filled by the sparse create; the
+        # targets of padding slots must read UNKNOWN, not class 0.
+        self._targets.rows[self.n_rows:] = UNKNOWN_LABEL
+
+    @property
+    def capacity(self) -> int:
+        return self._rows.capacity
+
+    # -- record application (service thread, drain points only) ----------
+
+    def apply_pool_record(self, record: Dict[str, Any]) -> np.ndarray:
+        """Append the record's rows; returns their pool ids.  Ids are a
+        pure function of arrival order, which is WAL order — replay
+        reproduces them exactly."""
+        rows, labels = decode_pool_payload(record, self.image_shape)
+        start = self.n_rows
+        n = len(rows)
+        grew = self._rows.ensure_capacity(start + n)
+        self._targets.ensure_capacity(start + n)
+        if grew:
+            self._targets.rows[start + n:] = UNKNOWN_LABEL
+        self._rows.rows[start:start + n] = rows
+        self._targets.rows[start:start + n] = (
+            np.asarray(labels, dtype=np.int64) if labels is not None
+            else UNKNOWN_LABEL)
+        self.n_rows = start + n
+        return np.arange(start, start + n, dtype=np.int64)
+
+    def apply_label_record(self, record: Dict[str, Any]
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+        """Attach labels to existing rows; returns (ids, labels).  Range
+        errors raise — a label for a row that never existed is a client
+        bug the WAL must not have admitted (the handler validates
+        against the acked id space before the WAL write)."""
+        ids = np.asarray(record["ids"], dtype=np.int64)
+        labels = np.asarray(record["labels"], dtype=np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.n_rows):
+            raise ValueError(
+                f"label record names rows outside [0, {self.n_rows})")
+        self._targets.rows[ids] = labels
+        return ids, labels
+
+    # -- dataset views ----------------------------------------------------
+
+    def make_datasets(self, train_view: ViewSpec, score_view: ViewSpec,
+                      length: Optional[int] = None
+                      ) -> Tuple["StreamDataset", "StreamDataset"]:
+        """(train_set, al_set) over shared storage — the with_view pair
+        of the offline path.  ``length`` defaults to the current valid
+        row count (build time uses the BASE length so eval/init-pool
+        seeds see only base rows)."""
+        train = StreamDataset(self, train_view, length=length)
+        al = StreamDataset(self, score_view, length=length)
+        return train, al
+
+    def snapshot(self) -> Tuple[np.ndarray, np.ndarray, int, int]:
+        """(rows_mm, targets_mm, capacity, n_rows) — what a drain
+        publishes into the datasets."""
+        return self._rows.rows, self._targets.rows, self.capacity, \
+            self.n_rows
+
+    def flush(self) -> None:
+        self._rows.flush()
+        self._targets.flush()
+
+
+class StreamDataset(Dataset):
+    """Frozen-snapshot Dataset view over a PoolStore.  ``images`` is the
+    FULL extent-capacity array (the resident upload's shape stays on the
+    bucket ladder); ``len`` is the capacity too, with padding slots
+    carried as PoolState ``invalid`` entries rather than a shorter
+    dataset — every consumer that compiles against the leading dim then
+    only ever sees ladder shapes."""
+
+    def __init__(self, store: PoolStore, view: ViewSpec,
+                 length: Optional[int] = None):
+        self.store = store
+        self.view = view
+        self.num_classes = store.num_classes
+        self.image_shape = store.image_shape
+        self._images, self._targets, self._capacity, self._n_valid = \
+            store.snapshot()
+        if length is not None:
+            self._len = int(length)
+        else:
+            self._len = self._capacity
+
+    def refresh(self, length: Optional[int] = None) -> None:
+        """Re-snapshot after a drain (service thread only).  The default
+        length becomes the new extent capacity — padding rides as
+        PoolState.invalid, keeping the upload shape on the ladder."""
+        self._images, self._targets, self._capacity, self._n_valid = \
+            self.store.snapshot()
+        self._len = int(length) if length is not None else self._capacity
+
+    @property
+    def images(self) -> np.ndarray:
+        return self._images
+
+    @property
+    def targets(self) -> np.ndarray:
+        return self._targets
+
+    @property
+    def n_valid(self) -> int:
+        return self._n_valid
+
+    def __len__(self) -> int:
+        return self._len
+
+    def gather(self, idxs: np.ndarray) -> np.ndarray:
+        idxs = np.asarray(idxs, dtype=np.int64)
+        return np.asarray(self._images[idxs])
+
+    def with_view(self, view: ViewSpec) -> "StreamDataset":
+        return StreamDataset(self.store, view, length=self._len)
+
+
+def decode_pool_payload(record: Dict[str, Any],
+                        image_shape: Tuple[int, int, int]
+                        ) -> Tuple[np.ndarray, Optional[List[int]]]:
+    """{"rows_b64", "shape", "labels"} -> (uint8 rows, labels|None),
+    validated against the pool's row shape.  Shared by the WAL-replay
+    path and the handler's admission validation (one decoder — the two
+    can never disagree on what a record means)."""
+    shape = record.get("shape")
+    if (not isinstance(shape, (list, tuple)) or len(shape) != 4
+            or not all(isinstance(d, int) and not isinstance(d, bool)
+                       and d >= 0 for d in shape)):
+        raise ValueError("pool record needs shape [n, h, w, c] of "
+                         "non-negative integers")
+    if tuple(shape[1:]) != tuple(image_shape):
+        raise ValueError(
+            f"rows of shape {list(shape[1:])} do not match the pool's "
+            f"row shape {list(image_shape)}")
+    n = int(shape[0])
+    if n <= 0:
+        raise ValueError("empty pool record")
+    raw = base64.b64decode(record["rows_b64"], validate=True)
+    if len(raw) != int(np.prod(shape)):
+        raise ValueError(f"payload of {len(raw)} bytes does not match "
+                         f"shape {list(shape)}")
+    rows = np.frombuffer(raw, dtype=np.uint8).reshape(shape)
+    labels = record.get("labels")
+    if labels is not None:
+        if (not isinstance(labels, list) or len(labels) != n
+                or not all(isinstance(v, int) and not isinstance(v, bool)
+                           and v >= 0 for v in labels)):
+            raise ValueError("labels must be one non-negative int per row")
+    return rows, labels

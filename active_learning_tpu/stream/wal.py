@@ -1,0 +1,228 @@
+"""The ingest write-ahead log: accepted rows must survive a kill at any
+point.
+
+One JSONL record per accepted ingest request, written + flushed +
+**fsync'd BEFORE the HTTP ack** (stream/ingest.py is statically held to
+that ordering by al_lint check 16).  The WAL is the streaming
+subsystem's source of durability truth: the growable pool store
+(stream/store.py) is derived state rebuilt from base data + WAL replay
+at every service start, and ``--resume_training`` replays the WAL
+idempotently — a mid-ingest kill loses only rows that were never acked.
+
+Record schema (one JSON object per line):
+
+  {"seq": n, "kind": "pool",  "crc": ..., "shape": [k,h,w,c],
+   "rows_b64": ..., "labels": [...]|null}
+  {"seq": n, "kind": "label", "crc": ..., "ids": [...], "labels": [...]}
+
+``seq`` is a contiguous 1-based counter across segments — replay order
+IS acceptance order, so applying records in file order reproduces the
+pool bit-identically.  ``crc`` covers the payload (crc32 of the
+rows_b64 / ids+labels text) so a torn-then-completed line can never
+replay as a half-record.
+
+Segments: the active file is ``wal.jsonl``; when it would exceed
+``rotate_bytes`` it is SEALED by an atomic rename to
+``wal_{first_seq:010d}.jsonl`` (the JsonlSink-rotation idiom: readers
+see either the whole old segment or the new empty active file, never a
+truncation) and a fresh active file opens.  Replay walks sealed
+segments in name order, then the active file.
+
+Torn-tail policy: only the LAST line of the LAST file may fail to parse
+— that is the record a kill interrupted mid-write, and since the ack
+only ever follows the fsync, dropping it loses nothing that was
+promised.  A torn line anywhere else is real corruption and raises.
+
+Failure semantics toward the client: an exception between the fsync and
+the ack (or a crash there) leaves a durable record whose ack was never
+delivered; a client that retries will append the rows again.  The WAL
+contract is therefore at-least-once for un-acked requests and
+exactly-once for acked ones — the standard WAL trade, documented in
+DESIGN.md §14.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .. import faults
+
+ACTIVE_FILE = "wal.jsonl"
+SEALED_GLOB = "wal_*.jsonl"
+
+# Lock discipline, statically enforced (scripts/al_lint.py
+# lock-discipline): the appender's file handle and counters are shared
+# between the ingest server's executor threads and the service thread's
+# bookkeeping reads — always under the WAL's _lock.
+_GUARDED_BY = {"_fh": "_lock", "_seq": "_lock",
+               "_active_bytes": "_lock", "_first_active_seq": "_lock"}
+
+
+def record_crc(record: Dict[str, Any]) -> int:
+    """crc32 over the payload fields (everything but seq/crc), with
+    sorted keys so the digest is layout-independent."""
+    payload = {k: v for k, v in record.items() if k not in ("seq", "crc")}
+    return zlib.crc32(
+        json.dumps(payload, sort_keys=True).encode()) & 0xFFFFFFFF
+
+
+class IngestWAL:
+    """Appender for one service process.  Thread contract: ``append``
+    runs on the ingest server's asyncio thread, ``backlog``/bookkeeping
+    reads on the service thread — all under ``_lock``."""
+
+    def __init__(self, directory: str, rotate_bytes: int = 64 << 20,
+                 replayed=None):
+        self.directory = directory
+        self.rotate_bytes = int(rotate_bytes)
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._path = os.path.join(directory, ACTIVE_FILE)
+        # Continue the seq chain across restarts: replay tells us the
+        # last durable seq (torn tail excluded — it was never acked).
+        # ``replayed``: a caller that already ran replay_wal on this
+        # directory (the service's startup) hands its records in so a
+        # gigabyte WAL is read + crc'd once per start, not twice.
+        records = (replayed if replayed is not None
+                   else replay_wal(directory)[0])
+        self._seq = records[-1]["seq"] if records else 0
+        self._first_active_seq: Optional[int] = None
+        # A kill mid-append leaves a torn (newline-less) tail; replay
+        # already refused to serve it, and appending AFTER it would glue
+        # the next record onto the fragment — truncate back to the last
+        # complete line before reopening for append.
+        if os.path.exists(self._path):
+            with open(self._path, "rb") as fh:
+                raw = fh.read()
+            if raw and not raw.endswith(b"\n"):
+                keep = raw.rfind(b"\n") + 1
+                with open(self._path, "r+b") as fh:
+                    fh.truncate(keep)
+        self._fh = open(self._path, "ab")
+        if self._fh.tell() > 0:
+            active = [r for r in records
+                      if r.get("_file") == ACTIVE_FILE]
+            if active:
+                self._first_active_seq = active[0]["seq"]
+        self._active_bytes = self._fh.tell()
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def append(self, record: Dict[str, Any]) -> int:
+        """Durably append one record; returns its seq.  The fsync
+        happens HERE, before control returns to the handler — the ack
+        the handler builds afterwards is only ever sent for rows already
+        on disk."""
+        with self._lock:
+            faults.site("wal_write")
+            seq = self._seq + 1
+            rec = dict(record, seq=seq)
+            rec["crc"] = record_crc(rec)
+            line = json.dumps(rec) + "\n"
+            data = line.encode()
+            if (self.rotate_bytes > 0 and self._active_bytes > 0
+                    and self._active_bytes + len(data) > self.rotate_bytes):
+                self._seal_locked()
+            # Two-part write with the torn fault point between: a kill
+            # here leaves a half line the replay's torn-tail rule drops
+            # — the record was never acked, so nothing promised is lost.
+            half = len(data) // 2
+            self._fh.write(data[:half])
+            self._fh.flush()
+            faults.site("wal_write", point="torn")
+            self._fh.write(data[half:])
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._seq = seq
+            self._active_bytes += len(data)
+            if self._first_active_seq is None:
+                self._first_active_seq = seq
+            return seq
+
+    def _seal_locked(self) -> None:
+        """Rotate the active file out under the held lock: close, atomic
+        rename to its sealed name (keyed by its first seq so name order
+        is replay order), reopen fresh."""
+        self._fh.close()
+        first = self._first_active_seq or (self._seq + 1)
+        sealed = os.path.join(self.directory, f"wal_{first:010d}.jsonl")
+        try:
+            os.replace(self._path, sealed)
+        except OSError:
+            # Keep appending to the same path (past the cap, but alive):
+            # a rotation hiccup must not cost durability.
+            pass
+        self._fh = open(self._path, "ab")
+        self._active_bytes = 0
+        self._first_active_seq = None
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            except (OSError, ValueError):
+                pass
+            self._fh.close()
+
+
+def _wal_files(directory: str) -> List[str]:
+    sealed = sorted(glob.glob(os.path.join(directory, SEALED_GLOB)))
+    active = os.path.join(directory, ACTIVE_FILE)
+    return sealed + ([active] if os.path.exists(active) else [])
+
+
+def replay_wal(directory: str) -> Tuple[List[Dict[str, Any]], int]:
+    """All durable records in acceptance order, plus the count of
+    dropped torn-tail lines (0 or 1).  Raises ValueError on corruption
+    anywhere except the final line of the final file, and on seq gaps —
+    a hole in the chain means a sealed segment went missing, which no
+    amount of replay can paper over."""
+    if not os.path.isdir(directory):
+        return [], 0
+    files = _wal_files(directory)
+    records: List[Dict[str, Any]] = []
+    dropped = 0
+    for fi, path in enumerate(files):
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        lines = raw.split(b"\n")
+        # A well-formed file ends with a newline -> last split is empty.
+        if lines and lines[-1] == b"":
+            lines.pop()
+        for li, line in enumerate(lines):
+            last = fi == len(files) - 1 and li == len(lines) - 1
+            try:
+                rec = json.loads(line.decode())
+                if not isinstance(rec, dict) or "seq" not in rec:
+                    raise ValueError("not a WAL record")
+                if rec.get("crc") != record_crc(rec):
+                    raise ValueError("crc mismatch")
+            except (ValueError, UnicodeDecodeError) as e:
+                if last:
+                    dropped += 1
+                    continue
+                raise ValueError(
+                    f"corrupt WAL record in {path} line {li + 1}: {e}")
+            rec["_file"] = os.path.basename(path)
+            records.append(rec)
+    for i, rec in enumerate(records):
+        if rec["seq"] != i + 1:
+            raise ValueError(
+                f"WAL seq gap: expected {i + 1}, found {rec['seq']} — a "
+                "sealed segment is missing or reordered")
+    return records, dropped
+
+
+def iter_payloads(records: List[Dict[str, Any]]) -> Iterator[Dict[str, Any]]:
+    """Records with the replay-internal ``_file`` tag stripped."""
+    for rec in records:
+        yield {k: v for k, v in rec.items() if k != "_file"}

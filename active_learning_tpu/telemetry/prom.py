@@ -93,11 +93,29 @@ def render(samples: Iterable[Sample],
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+# One labeled-gauge spelling for the flat gauge dicts the RunTelemetry
+# runtime carries: a key like ``rounds_triggered{cause=watermark}``
+# renders as ``al_run_rounds_triggered{cause="watermark"} v`` — the
+# streaming service's per-cause trigger counters use it, and the same
+# bracketed key is what rides metrics.jsonl (one spelling, two
+# channels, like every other gauge).
+_LABELED_KEY = re.compile(
+    r"^(?P<name>[^{}]+)\{(?P<label>[a-zA-Z0-9_]+)=(?P<value>[^{}=]*)\}$")
+
+
 def gauge_samples(gauges: Mapping[str, Any], prefix: str = ""
                   ) -> List[Sample]:
-    """Flat name->value mapping as samples (the driver's gauge dict)."""
-    return [(f"{prefix}{name}", None, value)
-            for name, value in sorted(gauges.items())]
+    """Flat name->value mapping as samples (the driver's gauge dict).
+    Keys matching ``name{label=value}`` become labeled samples."""
+    out: List[Sample] = []
+    for name, value in sorted(gauges.items()):
+        m = _LABELED_KEY.match(str(name))
+        if m:
+            out.append((f"{prefix}{m.group('name')}",
+                        {m.group("label"): m.group("value")}, value))
+        else:
+            out.append((f"{prefix}{name}", None, value))
+    return out
 
 
 def write_textfile(path: str, text: str) -> bool:

@@ -23,8 +23,15 @@ documented in README): 0 = healthy, 2 = no heartbeat, 3 = stale
 (staleness beats degradation — no progress is the worse state), 4 =
 alive but DEGRADED-MODE-ACTIVE (the journal's ``degrade`` list is
 non-empty: the run is making progress on a ladder rung — replicated
-pool, host feed, halved batch — and capacity planning should know).
-``--json`` emits the machine-readable summary either way.
+pool, host feed, halved batch — and capacity planning should know),
+5 = INGEST-STARVED (streaming runs only: the journal shows a WAL
+backlog with no round fired inside the deadline — the service is
+accepting rows faster than it serves them, or its trigger loop
+wedged).  ``--json`` emits the machine-readable summary either way.
+
+Streaming runs (the ``stream`` verb) additionally render a stream tail
+— pool rows, WAL backlog, last trigger cause and age — read from the
+same journal + heartbeat files.
 """
 
 from __future__ import annotations
@@ -153,8 +160,40 @@ def summarize(log_dir: str, stale_after: Optional[float] = None,
                     and journal.get("status") not in ("finished",
                                                       "crashed",
                                                       "preempted"))
+    # The streaming tail (stream/service.py journals these every poll):
+    # a NON-EMPTY WAL backlog whose last trigger is older than the
+    # staleness deadline means rows are being accepted faster than
+    # rounds serve them — --strict's exit 5.  A run that never fired a
+    # round yet is judged from the journal's own ts instead (a fresh
+    # service warming up is not starved).
+    stream = None
+    ingest_starved = False
+    if journal and journal.get("stream"):
+        deadline = (stale_after if stale_after is not None
+                    else float((heartbeats[0].get("deadline_s")
+                                if heartbeats else None) or 600.0))
+        last_trigger = journal.get("stream_last_trigger_ts")
+        anchor = last_trigger if last_trigger else journal.get("ts")
+        backlog = journal.get("stream_wal_backlog") or 0
+        trigger_age = (round(now - anchor, 1) if anchor else None)
+        ingest_starved = bool(
+            backlog > 0 and trigger_age is not None
+            and trigger_age > deadline
+            and journal.get("status") not in ("finished", "crashed",
+                                              "preempted"))
+        stream = {
+            "pool_rows_total": journal.get("stream_pool_rows"),
+            "wal_backlog_rows": backlog,
+            "wal_last_seq": journal.get("stream_wal_seq"),
+            "rounds_run": journal.get("stream_rounds_run"),
+            "last_trigger_cause": journal.get(
+                "stream_last_trigger_cause"),
+            "last_trigger_age_s": trigger_age,
+            "ingest_starved": ingest_starved,
+        }
     return {"log_dir": log_dir, "state": state, "heartbeats": heartbeats,
-            "journal": journal, "degraded": degraded, "metrics": metrics}
+            "journal": journal, "degraded": degraded, "stream": stream,
+            "ingest_starved": ingest_starved, "metrics": metrics}
 
 
 def render_text(summary: Dict[str, Any]) -> str:
@@ -187,6 +226,21 @@ def render_text(summary: Dict[str, Any]) -> str:
             lines.append("  DEGRADED: active ladder rungs "
                          f"{jr['degrade']} (reverts at the next round "
                          "boundary)")
+    st = summary.get("stream")
+    if st:
+        cause = st.get("last_trigger_cause") or "none yet"
+        age = (f"{st['last_trigger_age_s']}s ago"
+               if st.get("last_trigger_age_s") is not None else "never")
+        lines.append(
+            f"  stream: pool_rows={st.get('pool_rows_total')}  "
+            f"wal_backlog={st.get('wal_backlog_rows')}  "
+            f"rounds={st.get('rounds_run')}  "
+            f"last_trigger={cause} ({age})")
+        if st.get("ingest_starved"):
+            lines.append(
+                "  INGEST-STARVED: WAL backlog with no round fired "
+                "inside the deadline — the trigger loop is behind (or "
+                "wedged)")
     m = summary["metrics"]
     if m:
         lines.append("  latest metrics:")
@@ -241,6 +295,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # both healthy (0) and stale (3) so orchestrators can alert on
         # capacity loss without killing a self-healing run.
         return 4
+    if args.strict and summary.get("ingest_starved"):
+        # Streaming only: rows keep being accepted (the WAL backlog is
+        # non-empty) but no round fired inside the deadline — the
+        # service is alive yet falling behind its ingest, which an
+        # orchestrator should scale or alert on (degradation beats it:
+        # a run on a rung is already a stronger capacity signal).
+        return 5
     return 0
 
 

@@ -166,6 +166,15 @@ PHASES = [
     # batch-occupancy histogram, and asserts ZERO request-path compiles
     # after the bucket warmup (the test_compile_reuse counter).
     ("serve_throughput", 8, 64, 600),
+    # The STREAMING loop (active_learning_tpu/stream/): a real
+    # StreamService on loopback — ingest N synthetic rows through
+    # POST /v1/pool (+ labels through /v1/label) via the loadgen's
+    # ingest mode, the watermark trigger fires, a full AL round
+    # completes over the grown (extent-aligned) pool.  iters is the
+    # round count (bootstrap + triggered); per-chip batch bounds
+    # max_request_rows.  Records ingest rows/sec (WAL-fsync bound),
+    # ack p50/p99, and the trigger cause.
+    ("stream_round", 2, 64, 600),
     # BASELINE.md metric #1: real end-to-end AL rounds through the
     # production driver.  iters is the per-round epoch count.
     ("al_round_cifar", 4, 128, 900),
@@ -199,9 +208,13 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # bytes — the gradient-path riders on both TRAIN phases — ISSUE 10,
 # worst case '"bwd_frac":0.NNN,"grad_ar":"int8",' x2 ≈ 68 bytes — and
 # now the experiment-truth drift rider on both round phases — ISSUE
-# 13, worst case '"drift":0.NNNNNN,' x2 ≈ 36 bytes) without
-# truncation; staged truncation in _compact_line still guards the
-# pathological cases.  Pinned by unit tests at both extremes.
+# 13, worst case '"drift":0.NNNNNN,' x2 ≈ 36 bytes — and the streaming
+# phase — ISSUE 14: one more phase entry (~30 bytes) plus its riders,
+# worst case '"ack_p99":NNN.NNN,"trigger":"watermark",' ≈ 40 bytes)
+# without truncation; staged truncation in _compact_line still guards
+# the pathological cases.  14 phases now ride; the all-failed degraded
+# form stays under the 1750-byte tail-slop pin in
+# tests/test_bench_json.py.  Pinned by unit tests at both extremes.
 MAX_LINE_BYTES = 1900
 
 
@@ -1226,6 +1239,143 @@ def run_serve_phase(duration_s: int, max_batch: int) -> dict:
     }
 
 
+def run_stream_phase(rounds: int, max_batch: int) -> dict:
+    """The streaming-loop smoke: a real StreamService (ingest WAL +
+    growable pool + trigger scheduler + driver-phase rounds,
+    active_learning_tpu/stream/) on loopback, driven by the load
+    generator's ingest mode — N synthetic rows through POST /v1/pool
+    (+ a label fraction through /v1/label), the watermark trigger
+    fires, and a full AL round completes over the grown pool.  Records
+    ingest throughput (rows acked/sec — WAL-fsync bound), ack p50/p99,
+    the trigger cause, and the triggered round's wall.
+
+    AL_BENCH_STREAM_SMOKE=1 shrinks to a tiny linear model for CI; the
+    production capture streams into SSLResNet18 at the CIFAR shape —
+    the same model the serve phase scores."""
+    import importlib.util
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    from active_learning_tpu.config import (ExperimentConfig,
+                                            StreamConfig,
+                                            TelemetryConfig)
+    from active_learning_tpu.data.synthetic import get_data_synthetic
+    from active_learning_tpu.faults import preempt as preempt_lib
+    from active_learning_tpu.faults.preempt import PreemptionRequested
+    from active_learning_tpu.stream.service import StreamService
+    from active_learning_tpu.utils.metrics import NullSink
+
+    smoke = os.environ.get("AL_BENCH_STREAM_SMOKE") == "1"
+    n_chips = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
+    if smoke:
+        import sys as _sys
+        _sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tests"))
+        from helpers import TinyClassifier, tiny_train_config
+        model, train_cfg = TinyClassifier(num_classes=4), \
+            tiny_train_config()
+        pool_n, px, n_classes, epochs, budget = 96, 8, 4, 2, 8
+        ingest_rows, workers, watermark = 16, 2, 24
+    else:
+        model, train_cfg = None, None
+        pool_n, px, n_classes, epochs, budget = 2000, 32, 10, 2, 64
+        ingest_rows, workers, watermark = 64, 4, 256
+    rounds = max(2, int(rounds))  # bootstrap + >=1 triggered round
+    data = get_data_synthetic(n_train=pool_n, n_test=max(64, pool_n // 8),
+                              num_classes=n_classes, image_size=px,
+                              seed=7)
+    tmp = tempfile.mkdtemp(prefix="al_bench_stream_")
+    cfg = ExperimentConfig(
+        dataset="synthetic", arg_pool="synthetic",
+        strategy="MarginSampler", rounds=rounds, round_budget=budget,
+        model="SSLResNet18", n_epoch=epochs, early_stop_patience=epochs,
+        enable_metrics=False, log_dir=tmp, ckpt_path=tmp,
+        exp_hash="benchstream", round_pipeline="off",
+        telemetry=TelemetryConfig(enabled=True, heartbeat_every_s=0.0))
+    # max_rounds=0 (run forever): the phase stops the service itself
+    # once the triggered round lands, via the driver's own in-process
+    # preemption flag — exercising the SIGTERM checkpoint path for free.
+    scfg = StreamConfig(port=0, max_rounds=0, watermark_rows=watermark,
+                        drift_psi=0.0, max_interval_s=0.0, poll_s=0.05,
+                        max_request_rows=max(ingest_rows, max_batch),
+                        extent_floor=64 if smoke else 256)
+    service = StreamService(cfg, scfg, sink=NullSink(), data=data,
+                            train_cfg=train_cfg, model=model)
+    log(f"[stream_round] {n_chips}x {device_kind}, pool {pool_n}, "
+        f"watermark {watermark} rows, {workers} ingest workers x "
+        f"{ingest_rows} rows")
+    result_box: dict = {}
+
+    def run():
+        try:
+            result_box["strategy"] = service.run()
+        except BaseException as e:  # noqa: BLE001 - examined below
+            result_box["error"] = e
+
+    thread = threading.Thread(target=run, daemon=True,
+                              name="al-bench-stream")
+    t0 = time.perf_counter()
+    thread.start()
+    try:
+        assert service.ready.wait(300), "stream service never came up"
+        spec = importlib.util.spec_from_file_location(
+            "serve_loadgen", os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "scripts",
+                "serve_loadgen.py"))
+        loadgen = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(loadgen)
+        url = f"http://127.0.0.1:{service.port}"
+        ingest = loadgen.run_ingest_closed(
+            url, duration_s=2.0 if smoke else 5.0, workers=workers,
+            rows=ingest_rows, label_frac=0.25, image_shape=(px, px, 3))
+        # Bootstrap (round 0) + at least one TRIGGERED round.
+        deadline = time.monotonic() + 540
+        while service.rounds_run < 2 and thread.is_alive() \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert service.rounds_run >= 2, (
+            f"no triggered round completed (rounds_run="
+            f"{service.rounds_run})")
+    finally:
+        # Stop the run-forever loop through the preemption flag — the
+        # same checkpoint-and-exit path a real SIGTERM takes.
+        preempt_lib._handler(signal.SIGTERM, None)
+        thread.join(timeout=120)
+    total_sec = time.perf_counter() - t0
+    err = result_box.get("error")
+    if err is not None and not isinstance(err, PreemptionRequested):
+        raise err
+    shutil.rmtree(tmp, ignore_errors=True)
+    snap = service.metrics.snapshot()
+    lat = snap.get("latency_ms") or {}
+    return {
+        "phase": "stream_round",
+        # Headline: acked ingest rows/sec (the WAL-fsync-bound rate).
+        "ips": ingest["ips"],
+        "ips_per_chip": round(ingest["ips"] / n_chips, 1),
+        "unit": "ingested rows/sec (acked)",
+        "n_chips": n_chips,
+        "batch_per_chip": max_batch,
+        "pool_n": pool_n,
+        "rounds_run": service.rounds_run,  # bootstrap + triggered
+        "trigger_cause": service.last_trigger.get("cause"),
+        "ingest_qps": ingest["qps"],
+        "ack_p50_ms": lat.get("p50"),
+        "ack_p99_ms": lat.get("p99"),
+        "n_429": ingest["n_429"],
+        "labels_sent": ingest.get("labels_sent"),
+        "pool_rows_final": service.store.n_rows,
+        "pool_capacity_final": service.store.capacity,
+        "total_sec": round(total_sec, 1),
+        "smoke": smoke,
+        "device_kind": device_kind,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def run_al_round_phase(config: str, epochs: int) -> dict:
     """One REAL end-to-end AL experiment through the production driver —
     BASELINE.md metric #1 ("AL round wall-clock"), mirroring the
@@ -1820,6 +1970,9 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
     if phase == "serve_throughput":
         yield run_serve_phase(iters, per_chip)
         return
+    if phase == "stream_round":
+        yield run_stream_phase(iters, per_chip)
+        return
     config, kind = phase.rsplit("_", 1)
     n_chips = len(jax.devices())
     batch_size = per_chip * n_chips
@@ -2354,6 +2507,15 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                          ("step_time_ms_p50", "step_time_ms_p50"),
                          ("step_time_ms_p99", "step_time_ms_p99"),
                          ("backend", "be"),
+                         # The streaming phase's riders: the ack tail
+                         # latency (the WAL-fsync bound clients feel)
+                         # and which trigger fired the measured round —
+                         # an ingest-rate claim is ambiguous without
+                         # them.  The rest (qps, labels, pool growth)
+                         # stays in the evidence file.
+                         *((("ack_p99_ms", "ack_p99"),
+                            ("trigger_cause", "trigger"))
+                           if name == "stream_round" else ()),
                          # The resident-pool layout rides the line only
                          # where it is the phase's SUBJECT (the
                          # sharded-ceiling probe) — a row-sharded max-N

@@ -3,15 +3,20 @@
 #
 #   bash scripts/preflight.sh
 #
-# Chains the four gates a change must clear, fail-fast, in cost order:
+# Chains the five gates a change must clear, fail-fast, in cost order:
 #
-#   1. al_lint         the 15-check static analysis (seconds, no jax)
+#   1. al_lint         the 16-check static analysis (seconds, no jax)
 #   2. tier-1 tests    the ROADMAP.md tier-1 recipe (CPU 8-device mesh)
 #   3. bench smoke     the degraded-mode contract: bench.py with the
 #                      wall-clock budget pre-exhausted and a redirected
 #                      state dir must still emit its strict-parseable
 #                      final JSON line (the driver-parseable guarantee)
-#   4. run_report      scripts/run_report.py --selftest (the reporting
+#   4. stream smoke    the streaming loop end to end: a real
+#                      StreamService on loopback ingests synthetic rows
+#                      over HTTP, the watermark trigger fires, a full
+#                      AL round completes over the grown pool (the
+#                      bench stream_round phase in smoke mode)
+#   5. run_report      scripts/run_report.py --selftest (the reporting
 #                      layer renders synthetic runs end to end)
 #
 # Exit codes: 0 = every gate green; otherwise the exit code of the
@@ -23,10 +28,10 @@ set -euo pipefail
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO"
 
-echo "== preflight 1/4: al_lint (static analysis) =="
+echo "== preflight 1/5: al_lint (static analysis) =="
 python scripts/al_lint.py
 
-echo "== preflight 2/4: tier-1 tests =="
+echo "== preflight 2/5: tier-1 tests =="
 # The tier-1 recipe (ROADMAP.md): CPU backend, virtual 8-device mesh
 # via tests/conftest.py, slow tier excluded.
 set -o pipefail
@@ -35,7 +40,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_preflight_t1.log
 
-echo "== preflight 3/4: bench degraded-mode smoke =="
+echo "== preflight 3/5: bench degraded-mode smoke =="
 # Budget pre-exhausted + redirected state dir (the repo's captured
 # evidence must never be clobbered): the final stdout line must still
 # be strict JSON with the headline schema — the same contract
@@ -54,7 +59,27 @@ for key in ("metric", "value", "unit", "phases", "evidence"):
 print("bench degraded-mode line: ok")
 EOF
 
-echo "== preflight 4/4: run_report selftest =="
+echo "== preflight 4/5: stream_round smoke (ingest -> trigger -> round) =="
+# The streaming loop's end-to-end gate: the bench child in smoke mode
+# must ingest rows over HTTP, fire the watermark trigger, and complete
+# a full AL round — its JSON line is checked for the trigger evidence.
+timeout -k 10 420 env -u XLA_FLAGS JAX_PLATFORMS=cpu \
+    AL_BENCH_STREAM_SMOKE=1 python bench.py --phase stream_round \
+    --iters 2 --per-chip-batch 32 > "$BENCH_STATE/stream.txt"
+python - "$BENCH_STATE/stream.txt" <<'EOF'
+import json, sys
+lines = [l for l in open(sys.argv[1]).read().splitlines() if l.strip()]
+assert lines, "stream_round printed nothing to stdout"
+out = json.loads(lines[-1])
+assert out.get("phase") == "stream_round", out
+assert out.get("rounds_run", 0) >= 2, f"no triggered round: {out}"
+assert out.get("trigger_cause") == "watermark", out
+assert out.get("ips"), "no ingest rate recorded"
+print("stream_round smoke: ok "
+      f"({out['ips']} rows/s acked, ack p99 {out.get('ack_p99_ms')} ms)")
+EOF
+
+echo "== preflight 5/5: run_report selftest =="
 python scripts/run_report.py --selftest
 
 echo "preflight: ALL GATES GREEN"

@@ -1,8 +1,21 @@
-"""Closed- and open-loop load generator for the scoring service.
+"""Closed- and open-loop load generator for the scoring service AND the
+streaming ingest service.
 
     python scripts/serve_loadgen.py --url http://127.0.0.1:8000 \\
         [--mode closed|open|both] [--duration 10] [--workers 4] \\
         [--rows 8] [--qps 200] [--endpoint /v1/score]
+
+    # ingest mode (the stream verb's /v1/pool + /v1/label endpoints):
+    python scripts/serve_loadgen.py --url http://127.0.0.1:8008 \\
+        --ingest_rows 32 --label_frac 0.25 [--mode closed|open|both]
+
+``--ingest_rows`` switches the driver to ingest mode: requests carry
+``--ingest_rows`` random rows to ``POST /v1/pool``, acked ids are
+collected, and a ``--label_frac`` fraction of requests instead attach
+labels to previously-acked ids via ``POST /v1/label`` — so the new
+endpoints have a closed- AND open-loop driver exactly like /v1/score
+does.  429 backpressure is counted, not retried (offered load is part
+of the measurement, same as the scoring loops).
 
 Two loop disciplines, because they answer different questions:
 
@@ -66,7 +79,9 @@ def make_payload(image_shape, rows: int, seed: int = 0) -> bytes:
 
 
 class _Worker:
-    """One keep-alive connection; returns (status, latency_s) per post."""
+    """One keep-alive connection; returns (status, latency_s) per post
+    (``want_body=True`` additionally returns the response bytes — the
+    ingest loops parse acked ids out of them)."""
 
     def __init__(self, url: str, timeout: float = 30.0):
         p = urllib.parse.urlparse(url)
@@ -74,7 +89,7 @@ class _Worker:
         self._timeout = timeout
         self._conn: Optional[http.client.HTTPConnection] = None
 
-    def post(self, path: str, body: bytes):
+    def post(self, path: str, body: bytes, want_body: bool = False):
         t0 = time.perf_counter()
         for attempt in (0, 1):  # one reconnect on a dropped keep-alive
             if self._conn is None:
@@ -85,11 +100,14 @@ class _Worker:
                     "POST", path, body=body,
                     headers={"Content-Type": "application/json"})
                 resp = self._conn.getresponse()
-                resp.read()
+                payload = resp.read()
                 if resp.getheader("Connection", "").lower() == "close":
                     self._conn.close()
                     self._conn = None
-                return resp.status, time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                if want_body:
+                    return resp.status, dt, payload
+                return resp.status, dt
             except (http.client.HTTPException, OSError):
                 self._conn = None
                 if attempt:
@@ -210,6 +228,173 @@ def run_open(url: str, duration_s: float, qps: float, rows: int,
     return _summarize("open", statuses, lats, wall, rows, offered_qps=qps)
 
 
+# -- ingest mode: /v1/pool + /v1/label ---------------------------------------
+
+class _IngestState:
+    """Acked-but-unlabeled pool ids, shared across workers so label
+    requests always name ids the service actually promised."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids: List[int] = []
+        self.labels_sent = 0
+
+    def add(self, ids: List[int]) -> None:
+        with self._lock:
+            self._ids.extend(ids)
+
+    def take(self, n: int) -> List[int]:
+        with self._lock:
+            batch, self._ids = self._ids[:n], self._ids[n:]
+            self.labels_sent += len(batch)
+            return batch
+
+
+def make_pool_payload(image_shape, rows: int, seed: int = 0) -> bytes:
+    """A /v1/pool body: random uint8 rows, NO oracle labels — the ids
+    come back unlabeled so the /v1/label leg has something to attach
+    to."""
+    h, w, c = image_shape
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, size=(rows, h, w, c), dtype=np.uint8)
+    return json.dumps({
+        "rows_b64": base64.b64encode(images.tobytes()).decode(),
+        "shape": [rows, h, w, c],
+    }).encode()
+
+
+def _ingest_once(w: "_Worker", pool_body: bytes, state: _IngestState,
+                 label_frac: float, rows: int, rng):
+    """One ingest action: a /v1/label attach when the dice and the id
+    pool allow, else a /v1/pool append.  Returns (status, latency,
+    rows_appended) — label acks append ZERO rows, so the ingest rate is
+    computed from actual appends, never inflated by label traffic."""
+    if label_frac > 0 and rng.random() < label_frac:
+        ids = state.take(rows)
+        if ids:
+            body = json.dumps({
+                "ids": ids,
+                "labels": [int(i) % 10 for i in ids],
+            }).encode()
+            s, dt = w.post("/v1/label", body)
+            return s, dt, 0
+    s, dt, payload = w.post("/v1/pool", pool_body, want_body=True)
+    appended = 0
+    if s == 200:
+        try:
+            acked = json.loads(payload.decode()).get("ids") or []
+            state.add(acked)
+            appended = len(acked)
+        except (ValueError, AttributeError):
+            pass
+    return s, dt, appended
+
+
+def run_ingest_closed(url: str, duration_s: float, workers: int,
+                      rows: int, label_frac: float, image_shape) -> Dict:
+    """Closed loop over /v1/pool + /v1/label: N workers, back-to-back
+    requests — the ingest throughput ceiling (WAL fsync bound)."""
+    pool_body = make_pool_payload(image_shape, rows)
+    state = _IngestState()
+    stop_at = [float("inf")]
+    barrier = threading.Barrier(workers + 1)
+    lock = threading.Lock()
+    statuses: List[int] = []
+    lats: List[float] = []
+    appended_total = [0]
+
+    def loop(seed: int):
+        w = _Worker(url)
+        rng = np.random.default_rng(seed)
+        w.post("/v1/pool", pool_body, want_body=True)  # warm off-clock
+        barrier.wait()
+        local_s, local_l, local_rows = [], [], 0
+        while time.perf_counter() < stop_at[0]:
+            s, dt, appended = _ingest_once(w, pool_body, state,
+                                           label_frac, rows, rng)
+            local_s.append(s)
+            local_l.append(dt)
+            local_rows += appended
+        with lock:
+            statuses.extend(local_s)
+            lats.extend(local_l)
+            appended_total[0] += local_rows
+
+    threads = [threading.Thread(target=loop, args=(i,), daemon=True)
+               for i in range(workers)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    stop_at[0] = t0 + duration_s
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    out = _summarize("ingest_closed", statuses, lats, wall, rows)
+    # Rows actually appended (label acks append zero): the honest
+    # ingest rate, not n_ok * rows_per_request.
+    out["rows_appended"] = appended_total[0]
+    out["ips"] = (round(appended_total[0] / wall, 1) if wall > 0
+                  else 0.0)
+    out["workers"] = workers
+    out["label_frac"] = label_frac
+    out["labels_sent"] = state.labels_sent
+    return out
+
+
+def run_ingest_open(url: str, duration_s: float, qps: float, rows: int,
+                    label_frac: float, image_shape,
+                    max_inflight: int = 256) -> Dict:
+    """Open loop: ingest requests fire on schedule at ``qps`` regardless
+    of acks — how the 429 backpressure behaves past the WAL's rate."""
+    pool_body = make_pool_payload(image_shape, rows)
+    state = _IngestState()
+    lock = threading.Lock()
+    statuses: List[int] = []
+    lats: List[float] = []
+    appended_total = [0]
+    local = threading.local()
+
+    def one(i: int):
+        w = getattr(local, "w", None)
+        if w is None:
+            w = local.w = _Worker(url)
+            local.rng = np.random.default_rng(i)
+        try:
+            s, dt, appended = _ingest_once(w, pool_body, state,
+                                           label_frac, rows, local.rng)
+        except OSError:
+            s, dt, appended = -1, None, 0
+        with lock:
+            statuses.append(s)
+            appended_total[0] += appended
+            if dt is not None and s == 200:
+                lats.append(dt)
+
+    n = max(1, int(duration_s * qps))
+    interval = 1.0 / qps
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(max_inflight) as pool:
+        futures = []
+        for i in range(n):
+            target = t0 + i * interval
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futures.append(pool.submit(one, i))
+        for f in futures:
+            f.result()
+    wall = time.perf_counter() - t0
+    out = _summarize("ingest_open", statuses, lats, wall, rows,
+                     offered_qps=qps)
+    out["rows_appended"] = appended_total[0]
+    out["ips"] = (round(appended_total[0] / wall, 1) if wall > 0
+                  else 0.0)
+    out["label_frac"] = label_frac
+    out["labels_sent"] = state.labels_sent
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default="http://127.0.0.1:8000")
@@ -225,11 +410,34 @@ def main(argv=None) -> int:
                          "closed loop's measured qps)")
     ap.add_argument("--endpoint", default="/v1/score",
                     choices=["/v1/score", "/v1/predict"])
+    ap.add_argument("--ingest_rows", type=int, default=None,
+                    help="switch to ingest mode: rows per POST /v1/pool "
+                         "request against a `stream` service")
+    ap.add_argument("--label_frac", type=float, default=0.0,
+                    help="ingest mode: fraction of requests that attach "
+                         "labels (POST /v1/label) to acked ids")
     args = ap.parse_args(argv)
 
     health = fetch_health(args.url)
     shape = health["image_shape"]
     results = []
+    if args.ingest_rows is not None:
+        rows = args.ingest_rows
+        if args.mode in ("closed", "both"):
+            results.append(run_ingest_closed(
+                args.url, args.duration, args.workers, rows,
+                args.label_frac, shape))
+            print(json.dumps(results[-1]), flush=True)
+        if args.mode in ("open", "both"):
+            qps = args.qps
+            if qps is None:
+                base = results[0]["qps"] if results else 20.0
+                qps = max(1.0, 0.7 * base)
+            results.append(run_ingest_open(
+                args.url, max(1.0, args.duration / 2), qps, rows,
+                args.label_frac, shape))
+            print(json.dumps(results[-1]), flush=True)
+        return 0
     if args.mode in ("closed", "both"):
         results.append(run_closed(args.url, args.duration, args.workers,
                                   args.rows, shape, args.endpoint))

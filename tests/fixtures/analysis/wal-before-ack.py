@@ -1,0 +1,24 @@
+"""Golden negative case for the wal-before-ack checker: an ingest
+handler that constructs its ack BEFORE the WAL append (the durability
+promise nothing backs yet), one that never appends at all, and a jax
+import inside the handler module (host-purity violation)."""
+
+import jax  # host-purity violation: the ack path must never touch a device
+
+_INGEST_HANDLERS = ("rogue_pool_append", "rogue_label_attach")
+
+
+def make_ack(ids):
+    return {"ok": True, "ids": list(ids)}
+
+
+def rogue_pool_append(wal, queue, req):
+    rows = req["rows"]
+    response = make_ack(range(len(rows)))  # ack built before durability
+    wal.append({"kind": "pool", "rows": rows})
+    return response
+
+
+def rogue_label_attach(wal, queue, req):
+    jax.block_until_ready(req)  # device wait on the ack path
+    return make_ack(req["ids"])  # acks with no WAL append at all

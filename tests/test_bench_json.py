@@ -70,6 +70,9 @@ class TestDegradedModeLine:
         # ... and so does the train-feed comparison phase: the feed
         # hierarchy's numbers must never silently vanish from the line.
         assert "imagenet_train_feed" in out["failed"]
+        # ... and the streaming loop (ISSUE 14): the 14th phase rides
+        # the same degraded-line guarantee as the other 13.
+        assert "stream_round" in out["failed"]
         # The full evidence file landed in the REDIRECTED dir and is
         # itself strict-parseable.
         assert out["evidence"] == str(tmp_path / "bench_evidence.json")
@@ -199,6 +202,42 @@ class TestDegradedModeLine:
         # row-sharded max-N claim is meaningless without the layout tag.
         assert out["phases"]["kcenter_select_maxn"][
             "pool_sharding"] == "row"
+
+    def test_stream_round_riders_on_the_line(self, tmp_path):
+        """The streaming phase's compact-line riders (ISSUE 14): the
+        ack tail latency and the trigger cause ride the line (an ingest
+        rate is ambiguous without them); the finer figures (qps,
+        labels, pool growth) stay in the evidence file.  The
+        MAX_LINE_BYTES margin math at bench.MAX_LINE_BYTES accounts for
+        ~70 bytes of phase entry + riders."""
+        cache = {
+            "stream_round": {
+                "phase": "stream_round", "ips": 4002.2,
+                "ips_per_chip": 4002.2,
+                "unit": "ingested rows/sec (acked)",
+                "n_chips": 1, "device_kind": "cpu", "platform": "cpu",
+                "batch_per_chip": 64, "rounds_run": 2,
+                "trigger_cause": "watermark", "ingest_qps": 250.1,
+                "ack_p50_ms": 2.8, "ack_p99_ms": 42.4, "n_429": 0,
+                "pool_rows_final": 6304, "pool_capacity_final": 7168,
+                "captured_utc": "2026-01-01T00:00:00Z",
+            }
+        }
+        (tmp_path / "bench_cache.json").write_text(json.dumps(cache))
+        proc = _run_bench(tmp_path)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        sr = out["phases"]["stream_round"]
+        assert sr["ips"] == pytest.approx(4002.2)
+        assert sr["unit"] == "ingested rows/sec (acked)"
+        assert sr["ack_p99"] == pytest.approx(42.4)
+        assert sr["trigger"] == "watermark"
+        # Off the bounded line, in the evidence file only.
+        for key in ("ingest_qps", "ack_p50_ms", "pool_rows_final"):
+            assert key not in sr
+        # A streamed-ingest rate must never be billed as the training
+        # headline.
+        assert not out["metric"].startswith("stream_round")
 
     def test_legacy_ips_warm_alias_no_longer_rides(self, tmp_path):
         """A pre-rename cache entry carrying ONLY the deprecated

@@ -437,6 +437,123 @@ class TestGradPathCompileReuse:
                 f"flags: {deltas[rd]} jit cache misses")
 
 
+class TestStreamExtentCompileReuse:
+    def test_appended_extents_keep_warm_round_delta_zero(self, tmp_path):
+        """ISSUE 14's zero-new-compiles acceptance: a streaming run that
+        ingests rows BETWEEN rounds recompiles at most once per extent
+        boundary, never once per append.  Round 1 may pay the growth
+        tax (the pool crosses from its base length onto the extent
+        ladder, plus the first drift probe and first query); rounds 2-3
+        ingest MORE rows inside the same extent and must land at jit
+        cache-miss delta 0 — the same registry-counted metric the
+        production driver exports."""
+        import base64
+        import http.client
+        import json
+        import os
+        import signal
+        import threading
+        import time
+
+        from helpers import TinyClassifier, tiny_train_config
+        from active_learning_tpu.config import (ExperimentConfig,
+                                                StreamConfig,
+                                                TelemetryConfig)
+        from active_learning_tpu.data.synthetic import get_data_synthetic
+        from active_learning_tpu.faults import preempt as preempt_lib
+        from active_learning_tpu.stream.service import StreamService
+        from active_learning_tpu.utils.metrics import JsonlSink
+
+        tmp = str(tmp_path)
+        cfg = ExperimentConfig(
+            dataset="synthetic", arg_pool="synthetic",
+            strategy="MarginSampler", rounds=4, round_budget=8,
+            n_epoch=2, early_stop_patience=2, log_dir=tmp, ckpt_path=tmp,
+            exp_hash="streamwarm", round_pipeline="off",
+            telemetry=TelemetryConfig(enabled=True,
+                                      heartbeat_every_s=0.0))
+        # Floor 64: the first 8-row append grows the 96-row base onto
+        # the 128-slot extent; the next two appends stay INSIDE it.
+        scfg = StreamConfig(port=0, max_rounds=4, watermark_rows=8,
+                            drift_psi=0.0, max_interval_s=0.0,
+                            poll_s=0.02, extent_floor=64)
+        data = get_data_synthetic(n_train=96, n_test=32, num_classes=4,
+                                  image_size=8, seed=5)
+        svc = StreamService(cfg, scfg,
+                            sink=JsonlSink(tmp,
+                                           experiment_key="streamwarm"),
+                            data=data, train_cfg=tiny_train_config(),
+                            model=TinyClassifier(num_classes=4))
+        box = {}
+
+        def run():
+            try:
+                box["strategy"] = svc.run()
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                box["err"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        try:
+            assert svc.ready.wait(240)
+
+            def post_rows(n, seed):
+                rng = np.random.default_rng(seed)
+                rows = rng.integers(0, 256, size=(n, 8, 8, 3),
+                                    dtype=np.uint8)
+                body = json.dumps({
+                    "rows_b64":
+                        base64.b64encode(rows.tobytes()).decode(),
+                    "shape": [n, 8, 8, 3],
+                    "labels": [int(i) % 4 for i in range(n)]}).encode()
+                conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                                  timeout=30)
+                try:
+                    conn.request("POST", "/v1/pool", body=body)
+                    assert conn.getresponse().status == 200
+                finally:
+                    conn.close()
+
+            # One 8-row append between every pair of rounds: each lands
+            # in its own drain (watermark 8 fires the next round).
+            for prev_rounds, seed in ((1, 20), (2, 21), (3, 22)):
+                deadline = time.monotonic() + 240
+                while svc.rounds_run < prev_rounds and t.is_alive() \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert svc.rounds_run >= prev_rounds, (
+                    f"round {prev_rounds - 1} never completed")
+                post_rows(8, seed)
+            t.join(timeout=300)
+            assert not t.is_alive()
+            if "err" in box:
+                raise box["err"]
+        finally:
+            if t.is_alive():
+                preempt_lib._handler(signal.SIGTERM, None)
+                t.join(timeout=60)
+        strategy = box["strategy"]
+        assert svc.store.n_rows == 96 + 24
+        assert strategy.pool.n_pool == 128  # ONE extent, three appends
+        deltas = {}
+        with open(os.path.join(tmp, "metrics.jsonl")) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                if (ev.get("kind") == "metric"
+                        and "jit_cache_miss_delta" in ev.get("metrics",
+                                                             {})):
+                    deltas[ev.get("step")] = \
+                        ev["metrics"]["jit_cache_miss_delta"]
+        assert set(deltas) == {0, 1, 2, 3}
+        assert deltas[0] > 0  # the cold round pays the compiles ...
+        # Round 1 crosses the extent boundary (96 -> 128): at most one
+        # retrace per grown executable, tolerated once per boundary.
+        for rd in (2, 3):  # ... appends INSIDE the extent pay nothing.
+            assert deltas[rd] == 0, (
+                f"round {rd} compiled after an in-extent append: "
+                f"{deltas[rd]} jit cache misses")
+
+
 class TestCompilationCacheConfig:
     def test_driver_enables_persistent_cache(self, tmp_path, monkeypatch):
         from active_learning_tpu.experiment import driver
