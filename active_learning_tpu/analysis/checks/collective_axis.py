@@ -26,10 +26,19 @@ Rules:
     carry the axis as a field, threaded from the step builder).
     Anything else — an unregistered literal, an unresolvable
     expression — is a finding;
-  * a ``psum`` whose operand is a name assigned from ``jnp.where(...)``
-    in the same function is the masked owner-gather idiom: allowed only
-    inside ``parallel/mesh.owner_rows`` — everywhere else the fix hint
-    is to call ``mesh_lib.owner_rows``.
+  * a ``psum`` (or ``psum_scatter``) whose operand is a name assigned
+    from ``jnp.where(...)`` in the same function is the masked
+    owner-gather idiom: allowed only inside its one mesh_lib home —
+    ``parallel/mesh.owner_rows`` for the psum broadcast form,
+    ``parallel/mesh.owner_rows_scattered`` for the reduce-scatter form
+    (the ring column feed's block seeding) — everywhere else the fix
+    hint is to call the home;
+  * ``ppermute`` is the ring-feed idiom (rotate blocks device-to-
+    device around the mesh) and has exactly ONE home:
+    ``parallel/mesh.ring_shift``.  A second hand-rolled ring is where
+    the every-block-seen-exactly-once contract (and with it the
+    bit-identity of the k-center column scans) silently erodes —
+    anywhere else, the fix is to call ``mesh_lib.ring_shift``.
 
 Suppression: ``# al-lint: axis-ok <reason>``.
 """
@@ -211,17 +220,37 @@ class CollectiveAxisChecker(Checker):
                      "the new axis constant in parallel/mesh.py)"))
             return
         # The one-spelling owner-gather rule: psum of a where-masked
-        # select is mesh_lib.owner_rows' job.
-        if called == "psum" and fn_stack \
+        # select is mesh_lib.owner_rows' job; its reduce-scatter twin
+        # (psum_scatter of the same masked pick — the ring feed's block
+        # seeding) is owner_rows_scattered's.
+        _MASKED_HOMES = {"psum": "owner_rows",
+                         "psum_scatter": "owner_rows_scattered"}
+        if called in _MASKED_HOMES and fn_stack \
                 and self._is_masked_operand(node, fn_stack[-1]) \
-                and not (in_mesh and fn_stack[-1].name == "owner_rows"):
+                and not (in_mesh
+                         and fn_stack[-1].name == _MASKED_HOMES[called]):
+            home = _MASKED_HOMES[called]
             problems.append(Finding(
                 check=self.id, path=rel, line=node.lineno,
-                message="masked-psum owner-gather idiom spelled by hand "
-                        "(psum of a jnp.where-masked operand) — the one "
-                        "spelling lives in parallel/mesh.owner_rows",
-                hint="call mesh_lib.owner_rows(arr, idxs, axis) instead "
-                     "of re-deriving the masked psum"))
+                message=f"masked-{called} owner-gather idiom spelled by "
+                        f"hand ({called} of a jnp.where-masked operand) "
+                        f"— the one spelling lives in "
+                        f"parallel/mesh.{home}",
+                hint=f"call mesh_lib.{home}(arr, idxs, axis) instead "
+                     f"of re-deriving the masked {called}"))
+        # The one-home ring-feed rule: a bare ppermute IS the ring
+        # idiom, and its every-block-seen-exactly-once contract lives
+        # in exactly one place.
+        if called == "ppermute" and not (
+                in_mesh and any(fn.name == "ring_shift"
+                                for fn in fn_stack)):
+            problems.append(Finding(
+                check=self.id, path=rel, line=node.lineno,
+                message="ring-permute feed spelled by hand (bare "
+                        "ppermute) — the ring-feed idiom's one home is "
+                        "parallel/mesh.ring_shift",
+                hint="call mesh_lib.ring_shift(tree, ndev, axis) "
+                     "instead of re-deriving the ring ppermute"))
 
     @staticmethod
     def _is_masked_operand(call, fn) -> bool:

@@ -502,14 +502,27 @@ class ExperimentConfig:
     # optimizer HBM (bounded-delta; f32 is bit-parity with optax).
     optim_state_dtype: Optional[str] = None
 
-    # Gradient all-reduce precision override ("f32"/"int8"): None
-    # defers to the arg pool (default f32 = the bit-exact psum).  int8
-    # (EQuARX-style block-scaled quantized sync; wire win on 2-8 device
-    # meshes — see parallel/mesh.int8_allreduce) is bounded-delta,
-    # default-off, OFF on single-device meshes, and gated on the
-    # multichip learning probe at run start (a failed probe degrades to
-    # f32 loudly — journaled).
+    # Gradient all-reduce precision override
+    # ("f32"/"int8"/"int8_rs"/"auto"): None defers to the arg pool
+    # (default f32 = the bit-exact psum).  The quantized modes
+    # (EQuARX-style block-scaled sync) are bounded-delta, default-off,
+    # OFF on single-device meshes, and gated on the multichip learning
+    # probe at run start (a failed probe degrades to f32 loudly —
+    # journaled, sticky across resume).  The WIRE form is resolved per
+    # mesh (parallel/mesh.resolve_int8_wire): the all-gather form on
+    # 2-8 device meshes, the pod-tier reduce-scatter form
+    # (int8_reduce_scatter, ~2n bytes regardless of device count) above
+    # the crossover; "int8_rs" forces reduce-scatter, "auto" =
+    # quantized wherever a multi-device mesh makes it worth probing.
     grad_allreduce: Optional[str] = None
+
+    # Large-batch scaling ("auto"/"off"/None=off, DESIGN.md §15): auto
+    # applies the large-batch ConvNet scaling rules as the mesh grows —
+    # train batch x ndev (the arg pool's batch becomes PER-CHIP),
+    # linear lr x ndev, and a >=5-epoch gradual cosine warmup — so the
+    # pod-scale global batch doesn't silently cost accuracy.  Off keeps
+    # the arg pool's batch as the reference's global batch.
+    scale_batch: Optional[str] = None
 
     # Resident-pool layout override ("auto"/"replicated"/"row"): None
     # defers to the arg pool's TrainConfig.pool_sharding, whose default

@@ -187,14 +187,28 @@ def get_parser() -> argparse.ArgumentParser:
                         "bf16 (half the optimizer HBM; read bf16, "
                         "accumulate f32, bounded-delta)")
     p.add_argument("--grad_allreduce", type=str, default=None,
-                   choices=["f32", "int8"],
-                   help="gradient all-reduce precision across the mesh: "
-                        "f32 (default, bit-exact psum) or int8 "
-                        "(EQuARX-style block-scaled quantized sync, int8 "
-                        "wire payload; bounded-delta, off on "
-                        "single-device meshes, gated on the multichip "
-                        "learning probe — a failed probe degrades the "
-                        "run to f32 loudly)")
+                   choices=["f32", "int8", "int8_rs", "auto"],
+                   help="gradient sync precision across the mesh: f32 "
+                        "(default, bit-exact psum); int8 (EQuARX-style "
+                        "block-scaled quantized sync, int8 wire "
+                        "payload); int8_rs forces the pod-tier "
+                        "reduce-scatter wire form (~2n bytes regardless "
+                        "of device count — auto-picked above the "
+                        "~8-device crossover anyway); auto = quantized "
+                        "on any multi-device mesh.  All quantized modes "
+                        "are bounded-delta, off on single-device "
+                        "meshes, and gated on the multichip learning "
+                        "probe — a failed probe degrades the run to "
+                        "f32 loudly")
+    p.add_argument("--scale_batch", type=str, default=None,
+                   choices=["auto", "off"],
+                   help="large-batch scaling rules as the mesh grows "
+                        "(DESIGN.md §15): auto multiplies the train "
+                        "batch by the device count (the arg pool's "
+                        "batch becomes per-chip), scales lr linearly, "
+                        "and raises the cosine warmup to a >=5-epoch "
+                        "gradual ramp — so a pod-scale global batch "
+                        "doesn't silently cost accuracy")
     p.add_argument("--round_pipeline", type=str, default="auto",
                    choices=["auto", "off", "speculative"],
                    help="pipelined AL round: speculative overlaps the "
@@ -290,6 +304,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         fused_optimizer=args.fused_optimizer,
         optim_state_dtype=args.optim_state_dtype,
         grad_allreduce=args.grad_allreduce,
+        scale_batch=args.scale_batch,
         round_pipeline=args.round_pipeline,
         subset_labeled=args.subset_labeled,
         subset_unlabeled=args.subset_unlabeled,

@@ -133,11 +133,16 @@ def enable_compilation_cache(cache_dir: Optional[str] = None
 INT8_PROBE_MAX_ACC_DELTA = 0.05
 
 
-def run_grad_allreduce_probe(mesh) -> Tuple[bool, Optional[float]]:
-    """The multichip learning probe gating ``--grad_allreduce int8``
-    (DESIGN.md §4): train one tiny probe model twice over the live mesh
-    — once through the bit-exact f32 step, once through the int8
-    quantized-sync step, same seeds — and compare test accuracy.  The
+def run_grad_allreduce_probe(mesh, mode: str = "int8"
+                             ) -> Tuple[bool, Optional[float]]:
+    """The multichip learning probe gating the quantized gradient sync
+    (DESIGN.md §4 + §15): train one tiny probe model twice over the
+    live mesh — once through the bit-exact f32 step, once through the
+    quantized-sync step EXACTLY as the run would build it (``mode``
+    is the run's requested grad_allreduce, so the Trainer resolves the
+    same wire form: the all-gather int8 sync on 2-8 device meshes, the
+    pod-tier reduce-scatter form above the crossover or under
+    ``int8_rs``), same seeds — and compare test accuracy.  The
     same prove-it-learns discipline as ``__graft_entry__``'s dryrun
     gate: a subtly wrong quantized reduction keeps params finite and
     loss moving while computing the wrong numbers; only an accuracy
@@ -182,9 +187,10 @@ def run_grad_allreduce_probe(mesh) -> Tuple[bool, Optional[float]]:
             scheduler=SchedulerConfig(name="cosine", t_max=8),
             resident_scoring_bytes=0)
 
-        def fit_acc(mode: str) -> float:
+        def fit_acc(ar_mode: str) -> float:
             trainer = Trainer(_Probe(),
-                              _dc.replace(base_cfg, grad_allreduce=mode),
+                              _dc.replace(base_cfg,
+                                          grad_allreduce=ar_mode),
                               mesh, num_classes=4)
             # The probe fits on the DETERMINISTIC (al) view: the int8
             # step decorrelates per-shard augmentation keys, so an
@@ -203,7 +209,7 @@ def run_grad_allreduce_probe(mesh) -> Tuple[bool, Optional[float]]:
                                        np.arange(len(data[1])))
             return float(metrics["accuracy"])
 
-        delta = round(abs(fit_acc("f32") - fit_acc("int8")), 4)
+        delta = round(abs(fit_acc("f32") - fit_acc(mode)), 4)
         return delta <= INT8_PROBE_MAX_ACC_DELTA, delta
     except (Exception, faults.ThreadDeath) as e:  # noqa: BLE001
         # Degrade, never crash: ThreadDeath included deliberately — the
@@ -312,6 +318,29 @@ def build_experiment(
                                         grad_allreduce=cfg.grad_allreduce)
     if mesh is None:
         mesh = mesh_lib.make_mesh(cfg.num_devices)
+    # Large-batch scaling (--scale_batch auto, DESIGN.md §15): as the
+    # global batch grows with the mesh, the large-batch ConvNet rules
+    # (train/optim.apply_batch_scaling — batch x ndev so the arg pool's
+    # batch becomes per-chip, lr x ndev, >=5-epoch gradual warmup) keep
+    # accuracy from silently eroding at pod-scale batch sizes.  Off by
+    # default: the arg pool's batch stays the reference's GLOBAL batch.
+    scale_mode = getattr(cfg, "scale_batch", None) or "off"
+    if scale_mode not in ("auto", "off"):
+        raise ValueError(
+            f"scale_batch={scale_mode!r} is not one of 'auto'/'off'")
+    if scale_mode == "auto":
+        from ..train.optim import apply_batch_scaling
+        train_cfg, scaled = apply_batch_scaling(train_cfg,
+                                                mesh.devices.size)
+        if scaled:
+            get_logger().info(
+                "scale_batch=auto: global batch "
+                f"{train_cfg.loader_tr.batch_size} "
+                f"({mesh.devices.size} devices x per-chip "
+                f"{train_cfg.loader_tr.batch_size // mesh.devices.size}),"
+                f" lr {train_cfg.optimizer.lr:g}, warmup "
+                f"{train_cfg.scheduler.warmup_epochs} epochs "
+                "(large-batch scaling rules)")
     # The quantized gradient sync is GATED, not just flagged
     # (DESIGN.md §4): int8 only engages when the mesh is multi-device
     # (resolve_grad_allreduce) AND the multichip learning probe passes —
@@ -323,10 +352,12 @@ def build_experiment(
     grad_allreduce_degraded = False
     requested_ar = getattr(train_cfg, "grad_allreduce", "f32") or "f32"
     if mesh_lib.resolve_grad_allreduce(requested_ar, mesh) == "int8":
-        ok, delta = run_grad_allreduce_probe(mesh)
+        wire = mesh_lib.resolve_int8_wire(requested_ar, mesh)
+        ok, delta = run_grad_allreduce_probe(mesh, requested_ar)
         if not ok:
             get_logger().warning(
-                "grad_allreduce=int8 FAILED the multichip learning probe "
+                f"grad_allreduce={requested_ar} ({wire} wire form) "
+                "FAILED the multichip learning probe "
                 f"(accuracy delta {delta if delta is not None else 'n/a'} "
                 f"vs bound {INT8_PROBE_MAX_ACC_DELTA}); degrading this "
                 "run to the bit-exact f32 gradient sync")
@@ -334,7 +365,8 @@ def build_experiment(
             grad_allreduce_degraded = True
         else:
             get_logger().info(
-                "grad_allreduce=int8: learning probe passed "
+                f"grad_allreduce={requested_ar}: learning probe passed "
+                f"on the {wire} wire form "
                 f"(accuracy delta {delta} <= {INT8_PROBE_MAX_ACC_DELTA})")
     trainer = Trainer(model, train_cfg, mesh, num_classes)
     trainer.grad_allreduce_degraded = grad_allreduce_degraded
@@ -865,20 +897,13 @@ def run_experiment(cfg: ExperimentConfig, sink: Optional[MetricsSink] = None,
         # last merged row (accuracy-vs-time must stay monotone across a
         # preemption; a fresh-zero clock would make round N+1 look
         # cheaper than round N).  Preemption downtime is not counted —
-        # the curve measures compute time spent, not queue luck.
+        # the curve measures compute time spent, not queue luck.  ONE
+        # resume-merge rule, shared with the stream service
+        # (diag_lib.resume_report_rows).
         report_wall_base = 0.0
         if write_report and start_round > 0:
-            prior_report = diag_lib.read_run_report(run_report_path)
-            if prior_report and prior_report.get("exp_hash") == \
-                    cfg.exp_hash:
-                report_rows = [
-                    r for r in prior_report.get("rounds", [])
-                    if isinstance(r, dict)
-                    and isinstance(r.get("round"), int)
-                    and r["round"] < start_round]
-                report_wall_base = max(
-                    (float(r.get("wall_clock_s") or 0.0)
-                     for r in report_rows), default=0.0)
+            report_rows, report_wall_base = diag_lib.resume_report_rows(
+                run_report_path, cfg.exp_hash, start_round)
         report_header = {
             "exp_name": cfg.exp_name, "exp_hash": cfg.exp_hash,
             "strategy": cfg.strategy, "dataset": cfg.dataset,

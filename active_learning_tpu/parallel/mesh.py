@@ -49,6 +49,27 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         return
     if num_processes is not None and num_processes <= 1:
         return
+    # XLA:CPU cannot run cross-process computations on its default
+    # (in-process) collectives — a 2-process CPU mesh dies at the first
+    # jit with "Multiprocess computations aren't implemented on the CPU
+    # backend".  The gloo implementation CAN, and it is how the pod-tier
+    # contract is tested without hardware (the 2-process localhost
+    # harness in tests/test_pod_tier.py).  Armed only when the
+    # configured platform is CPU — read from the env var OR the jax
+    # config knob (both settable without initializing a backend; a
+    # jax.config.update("jax_platforms", "cpu") launch must arm too);
+    # accelerators keep their native ICI/DCN collectives, and a jax too
+    # old to know the knob just proceeds.
+    spec = os.environ.get("JAX_PLATFORMS") or ""
+    try:
+        spec = jax.config.jax_platforms or spec
+    except AttributeError:  # pragma: no cover - very old jax
+        pass
+    if spec.split(",")[0].strip().lower() == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - jax-version-dependent
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes, process_id=process_id)
@@ -227,7 +248,16 @@ def shard_rows(array: np.ndarray, mesh: Mesh,
     never lands whole on any single device.  That bounds the transient
     host overhead at one shard instead of one pool: a 10.5 GB factor
     matrix costs ~10.5/ndev GB of working copy, not a second 10.5 GB,
-    and 10.5/ndev GB per chip once resident."""
+    and 10.5/ndev GB per chip once resident.
+
+    Multi-process meshes (the pod tier, DESIGN.md §15): per-process
+    shard assembly via ``jax.make_array_from_process_local_data`` —
+    each host slices and uploads ONLY its own contiguous row range of
+    the global array, so the full pool never lands whole on any one
+    host either; the assembled array is identical to the single-process
+    layout shard for shard.  ``array`` may be any host sequence that
+    slices to the local range (an in-memory pool, a memmap, a
+    shard-serving reader) — only the local rows are ever touched."""
     faults.site("shard_upload")
     n = array.shape[0]
     total = n if rows is None else int(rows)
@@ -236,19 +266,27 @@ def shard_rows(array: np.ndarray, mesh: Mesh,
     total += row_shard_pad(total, mesh)
     tail = array.shape[1:]
 
-    def _shard(index):
+    def _block(lo: int, hi: int) -> np.ndarray:
         # Per-shard fault point: one block's H2D can fail while its
         # siblings succeed (the caller's RetryPolicy re-runs the upload).
         faults.site("shard_upload", point="torn")
-        rs = index[0]
-        lo = rs.start or 0
-        hi = total if rs.stop is None else rs.stop
         block = np.ascontiguousarray(array[lo:min(hi, n)])
         short = (hi - lo) - block.shape[0]
         if short:
             block = np.concatenate(
                 [block, np.zeros((short, *tail), array.dtype)])
         return block
+
+    if is_multiprocess(mesh):
+        local = process_local_rows(mesh, total)
+        return jax.make_array_from_process_local_data(
+            row_sharding(mesh), _block(local.start, local.stop),
+            (total, *tail))
+
+    def _shard(index):
+        rs = index[0]
+        lo = rs.start or 0
+        return _block(lo, total if rs.stop is None else rs.stop)
 
     return jax.make_array_from_callback(
         (total, *tail), row_sharding(mesh), _shard)
@@ -274,6 +312,53 @@ def owner_rows(arr: Any, idxs: Any, axis: str = DATA_AXIS) -> Any:
     return jax.lax.psum(picked, axis)
 
 
+def owner_rows_scattered(arr: Any, idxs: Any, axis: str = DATA_AXIS) -> Any:
+    """``owner_rows``' reduce-scatter twin: rows of the shard-local
+    ``arr`` for GLOBAL row indices ``idxs`` [K] (REPLICATED — every
+    shard passes the same vector), assembled from their owning shards
+    and SCATTERED — shard i receives rows [i*K/ndev, (i+1)*K/ndev) of
+    the result instead of the full [K].  Exact for the same reason
+    owner_rows is (each element sums exactly one owner value plus
+    zeros — any reduction order is the owner's bits), at 1/ndev the
+    wire of the full psum broadcast.  The ring column feed seeds each
+    shard's starting center block with this (strategies/kcenter.py);
+    like owner_rows, this is the ONE spelling of the masked-scatter
+    idiom (al_lint collective-axis).  K must divide the mesh."""
+    rows = arr.shape[0]
+    off = (jax.lax.axis_index(axis) * rows).astype(idxs.dtype)
+    loc = jnp.clip(idxs - off, 0, rows - 1)
+    mine = (idxs >= off) & (idxs < off + rows)
+    picked = jnp.where(mine.reshape((-1,) + (1,) * (arr.ndim - 1)),
+                       arr[loc], jnp.zeros((), arr.dtype))
+    return jax.lax.psum_scatter(picked, axis, scatter_dimension=0,
+                                tiled=True)
+
+
+def ring_shift(tree: Any, ndev: int, axis: str = DATA_AXIS) -> Any:
+    """THE ring-permute column-feed primitive — the ONE spelling of the
+    ring-feed idiom (statically enforced: al_lint collective-axis allows
+    a ring-perm ``ppermute`` only here).  Inside a ``shard_map`` body
+    over ``axis``: rotate each shard's block to its RIGHT neighbor
+    (shard i's block lands on shard (i+1) % ndev), so after ndev
+    successive shifts every shard has held every other shard's block
+    exactly once and the blocks are home again.  This is SNIPPETS.md
+    [1]'s classic TPU ring pattern spelled with ``lax.ppermute`` (XLA
+    lowers it to collective-permute on the ICI ring) instead of a
+    hand-rolled Pallas DMA — same wire schedule, composes under jit and
+    ``lax.fori_loop``.
+
+    The k-center initial-min/minimax scans fold distance strips over the
+    rotating blocks (strategies/kcenter.py): each hop moves one block of
+    labeled-center columns between neighbors instead of uploading host
+    column blocks and broadcasting them to every device — min/max folds
+    over the rotating blocks are exact, so consumers stay bit-identical
+    to the replicated column scans.  ``ndev`` must be the mesh's static
+    device count (the permutation is a trace-time constant)."""
+    perm = [(i, (i + 1) % ndev) for i in range(ndev)]
+    return jax.tree.map(
+        lambda x: jax.lax.ppermute(x, axis, perm=perm), tree)
+
+
 def is_row_sharded(array: Any) -> bool:
     """True when a device array's leading axis is split over a mesh axis
     (the row-sharded pool layout), read off the committed sharding —
@@ -286,9 +371,9 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-# -- quantized gradient sync (DESIGN.md §4, "The gradient path") ----------
+# -- quantized gradient sync (DESIGN.md §4 + §15, "The gradient path") ----
 
-GRAD_ALLREDUCE_MODES = ("f32", "int8")
+GRAD_ALLREDUCE_MODES = ("f32", "int8", "int8_rs", "auto")
 
 # Elements per quantization block: one f32 scale amortized over 256
 # int8 payload bytes (~1.6% scale overhead), small enough that a block
@@ -296,18 +381,78 @@ GRAD_ALLREDUCE_MODES = ("f32", "int8")
 # scales clip outlier-heavy gradients; per-block ones track them).
 INT8_BLOCK = 256
 
+# The wire-form crossover (documented since PR 9, now acted on): the
+# all_gather-shaped int8_allreduce moves (ndev-1)*n int8 bytes per
+# device — a real win over the ~8n-byte f32 ring psum through 8
+# devices, INVERTED past ~9.  Above this device count the int8 path
+# switches to the reduce-scatter wire form (~2n bytes, ndev-free).
+INT8_WIRE_CROSSOVER_NDEV = 8
+
+INT8_WIRE_FORMS = ("allgather", "reduce_scatter")
+
 
 def resolve_grad_allreduce(mode: str, mesh: Mesh) -> str:
     """The ONE rule for which gradient-sync path a Trainer builds:
-    ``int8`` only on multi-device meshes (a single device has no wire
-    to save — the quantization would cost accuracy for nothing);
-    anything else is the partitioner's bit-exact f32 psum."""
+    quantized sync (``int8``/``int8_rs``/``auto``) only on multi-device
+    meshes (a single device has no wire to save — the quantization
+    would cost accuracy for nothing); anything else is the
+    partitioner's bit-exact f32 psum.  Returns "f32" or "int8" — the
+    WIRE form within int8 (all-gather vs reduce-scatter) is a separate
+    resolution, ``resolve_int8_wire``."""
     if mode not in GRAD_ALLREDUCE_MODES:
         raise ValueError(f"grad_allreduce={mode!r} is not one of "
                          f"{'/'.join(GRAD_ALLREDUCE_MODES)}")
-    if mode == "int8" and mesh.devices.size <= 1:
+    if mesh.devices.size <= 1:
         return "f32"
+    if mode in ("int8", "int8_rs", "auto"):
+        return "int8"
     return mode
+
+
+def resolve_int8_wire(mode: str, mesh: Mesh) -> str:
+    """Which WIRE the quantized gradient sync uses, from the requested
+    mode + the mesh: ``int8_rs`` forces the reduce-scatter form (tests,
+    A/B captures); ``int8``/``auto`` pick reduce-scatter above the
+    documented ~8-device crossover and keep the proven all-gather form
+    on 2-8 device meshes (where (ndev-1)*n < 8n already wins and one
+    quantization round-trip beats two).  Meaningless for f32 — callers
+    gate on ``resolve_grad_allreduce`` first."""
+    if mode == "int8_rs":
+        return "reduce_scatter"
+    if mesh.devices.size > INT8_WIRE_CROSSOVER_NDEV:
+        return "reduce_scatter"
+    return "allgather"
+
+
+def wire_model_bytes(form: str, ndev: int, n: int,
+                     block: int = INT8_BLOCK) -> int:
+    """Per-device wire bytes to sync one ``n``-element f32 gradient
+    tree, by form — the pod-tier wire-model table (DESIGN.md §15),
+    cross-checked against measured ``collective_bytes_total`` in
+    tests/test_pod_tier.py:
+
+      ``f32``            ring all-reduce: reduce-scatter + all-gather
+                         passes, ~2 * 4n * (ndev-1)/ndev  (~8n);
+      ``allgather``      PR 9's int8_allreduce: every device receives
+                         every other device's quantized payload —
+                         (ndev-1) * (n + 4n/block) int8+scale bytes,
+                         LINEAR in ndev (the documented blowup);
+      ``reduce_scatter`` the EQuARX-shaped form: all_to_all of the
+                         quantized shards + all_gather of the
+                         re-quantized reduced shards, each moving
+                         (ndev-1)/ndev * (n + 4n/block) — ~2n total,
+                         ndev-free.
+    """
+    if ndev <= 1:
+        return 0
+    scale_bytes = 4 * -(-n // block)
+    if form == "f32":
+        return int(2 * 4 * n * (ndev - 1) / ndev)
+    if form == "allgather":
+        return (ndev - 1) * (n + scale_bytes)
+    if form == "reduce_scatter":
+        return int(2 * (n + scale_bytes) * (ndev - 1) / ndev)
+    raise ValueError(f"unknown wire form {form!r}")
 
 
 def int8_allreduce(tree: Any, axis: str = DATA_AXIS,
@@ -364,6 +509,94 @@ def int8_allreduce(tree: Any, axis: str = DATA_AXIS,
         # grad-norm telemetry and any NaN guard still see it.
         out = jnp.where(jnp.isfinite(absmax)[:, None],
                         total * scale[:, None], jnp.float32(jnp.nan))
+        out = out.reshape(-1)
+        if pad:
+            out = out[:n]
+        return out.reshape(shape).astype(dtype)
+
+    return jax.tree.map(one, tree)
+
+
+def int8_reduce_scatter(tree: Any, ndev: int, axis: str = DATA_AXIS,
+                        block: int = INT8_BLOCK) -> Any:
+    """The pod-tier quantized gradient sync (DESIGN.md §15): EQuARX-
+    shaped block-scaled int8 REDUCE-SCATTER + all-gather of the
+    re-quantized reduced shards, inside a ``shard_map`` body over
+    ``axis``.  Fixes ``int8_allreduce``'s documented wire blowup — that
+    form moves ``(ndev-1) * n`` int8 bytes per device (every device
+    receives every other device's payload), inverted vs the ~8n f32
+    ring psum past ~9 devices; this one moves ``~2n`` regardless of
+    ndev (``wire_model_bytes``), which is why ``resolve_int8_wire``
+    auto-selects it above the crossover.
+
+    Wire schedule, per leaf:
+
+      1. quantize the local gradient to int8 against a SHARED per-block
+         scale (pmax of the block absmax — sums must commute);
+      2. ``all_to_all`` the quantized payload: each device sends shard
+         j of its blocks to device j and receives ITS shard from every
+         peer — ``(ndev-1)/ndev * n`` int8 bytes, the reduce-scatter
+         leg (XLA exposes no requantizing reduce-scatter op; EQuARX
+         requantizes inside a modified ring, which is not
+         user-expressible — all_to_all + local f32 sum is the same
+         bytes with the sum hoisted to the shard owner);
+      3. each shard owner accumulates its slice in float32 and
+         RE-QUANTIZES it against its own fresh per-block scale;
+      4. ``all_gather`` the quantized reduced shards + their scales —
+         ``(ndev-1)/ndev * n`` int8 bytes + the ~1.6% scale sidecar —
+         and dequantize.
+
+    Deterministic and replicated: every device dequantizes the SAME
+    owner-produced bytes, and the f32 accumulation order over the
+    device axis is fixed — the result is identical on every device.
+    Bounded error: first quantization contributes <= ndev * scale1 / 2
+    per element (scale1 = blockmax/127), the requantization another
+    scale2 / 2 — one quantization round-trip more than the all-gather
+    form, which is why the 2-8 device meshes keep that form and why
+    BOTH sit behind the same learning probe (driver.
+    run_grad_allreduce_probe probes whichever form the mesh resolves).
+    Non-finite blocks poison to NaN exactly like ``int8_allreduce``.
+    ``ndev`` must be the mesh's static device count."""
+    def one(x):
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return jax.lax.psum(x, axis)
+        shape, dtype = x.shape, x.dtype
+        flat = x.reshape(-1).astype(jnp.float32)
+        n = flat.shape[0]
+        pad = (-n) % (block * ndev)
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+        blocks = flat.reshape(-1, block)
+        nb = blocks.shape[0]
+        m = nb // ndev
+        absmax = jax.lax.pmax(jnp.max(jnp.abs(blocks), axis=1), axis)
+        scale = jnp.maximum(absmax, jnp.float32(1e-30)) / 127.0
+        q = jnp.clip(jnp.round(blocks / scale[:, None]),
+                     -127, 127).astype(jnp.int8)
+        # Reduce-scatter leg: int8 on the wire, each device ends up
+        # holding every peer's copy of ITS m-block shard.
+        recv = jax.lax.all_to_all(q.reshape(ndev, m, block), axis,
+                                  split_axis=0, concat_axis=0)
+        me = jax.lax.axis_index(axis)
+        # The shared scale vector is replicated math, so slicing my
+        # shard of it is local; the f32 sum over the device axis is the
+        # exact sum of <=127-magnitude integers times one scale.
+        my_scale = jax.lax.dynamic_slice_in_dim(
+            scale.reshape(ndev, m), me, 1, 0)[0]
+        reduced = jnp.sum(recv.astype(jnp.float32), axis=0) \
+            * my_scale[:, None]
+        absmax2 = jnp.max(jnp.abs(reduced), axis=1)
+        scale2 = jnp.maximum(absmax2, jnp.float32(1e-30)) / 127.0
+        q2 = jnp.clip(jnp.round(reduced / scale2[:, None]),
+                      -127, 127).astype(jnp.int8)
+        # All-gather leg: quantized reduced shards + the scale sidecar.
+        gathered = jax.lax.all_gather(q2, axis)
+        scales = jax.lax.all_gather(scale2, axis)
+        out = gathered.astype(jnp.float32) * scales[:, :, None]
+        # Same poison rule as int8_allreduce: a non-finite block must
+        # SURFACE as NaN, never launder into a zero gradient.
+        out = jnp.where(jnp.isfinite(absmax).reshape(ndev, m)[:, :, None],
+                        out, jnp.float32(jnp.nan))
         out = out.reshape(-1)
         if pad:
             out = out[:n]

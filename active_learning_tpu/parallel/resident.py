@@ -41,10 +41,12 @@ replaces a host->device transfer.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
@@ -77,17 +79,21 @@ _UPLOAD_RETRY = faults.RetryPolicy(site="h2d_upload",
 _CACHE_LOCK = threading.RLock()
 
 # Lock discipline, statically enforced (scripts/al_lint.py
-# lock-discipline): the cache's three shared maps may only be touched
-# under _CACHE_LOCK — the speculative scorer, the trainer's validation,
-# and the LRU/demotion paths all race on them otherwise.
+# lock-discipline): the cache's shared maps may only be touched under
+# _CACHE_LOCK — the speculative scorer, the trainer's validation, and
+# the LRU/demotion paths all race on them otherwise.  ``update_warm``
+# is the incremental updater's warmed-(layout, shape) marker set.
 _GUARDED_BY = {"images": "_CACHE_LOCK",
                "steps": "_CACHE_LOCK",
-               "lru": "_CACHE_LOCK"}
+               "lru": "_CACHE_LOCK",
+               "update_warm": "_CACHE_LOCK"}
 
-# Registered step-builder (al_lint recompile-hazard): the jitted
+# Registered step-builders (al_lint recompile-hazard): the jitted
 # gather+step runners are built once per (step_fn, labels, layout) and
-# cached in the shared resident pool.
-_STEP_BUILDERS = ("get_runner",)
+# cached in the shared resident pool; the incremental row updater is
+# built once per (layout, window width) the same way, and its warm-up
+# dummy is a once-per-(layout, shape) device-side zeros.
+_STEP_BUILDERS = ("get_runner", "_update_runner", "_dummy_like")
 
 # HBM held back from the auto-sized resident budget: training activations,
 # XLA workspace, and the model/optimizer trees all coexist with a pinned
@@ -165,19 +171,20 @@ def resolve_budget(spec: Optional[int],
 def resolve_sharding(spec: Optional[str], mesh) -> str:
     """TrainConfig.pool_sharding -> the concrete resident layout,
     "replicated" or "row".  "auto" (or None): row whenever the mesh has
-    more than one device in a single process — per-chip residency then
-    scales 1/ndev with chip count for free.  Row sharding is gated off
-    multi-process meshes (per-process shard assembly is future work —
-    replicated stays the pod answer) and single-device meshes (sharding
-    over one device is replication with extra steps)."""
+    more than one device — per-chip residency then scales 1/ndev with
+    chip count for free, and on MULTI-PROCESS meshes (the pod tier,
+    DESIGN.md §15) each host additionally assembles only its own shard
+    of the upload (mesh_lib.shard_rows' per-process arm), so the pool
+    never lands whole on any one host either.  Only single-device
+    meshes stay replicated (sharding over one device is replication
+    with extra steps)."""
     if spec in (None, "auto"):
         spec = "row"
     if spec not in ("replicated", "row"):
         raise ValueError(
             f"pool_sharding={spec!r} is not one of 'auto'/'replicated'/"
             "'row'")
-    if spec == "row" and (mesh is None or mesh.devices.size <= 1
-                          or mesh_lib.is_multiprocess(mesh)):
+    if spec == "row" and (mesh is None or mesh.devices.size <= 1):
         return "replicated"
     return spec
 
@@ -276,8 +283,7 @@ def pool_arrays(cache: Dict, dataset: Any, mesh,
 
             def _upload():
                 faults.site("h2d_upload")
-                if sharding == "row" and mesh.devices.size > 1 \
-                        and not mesh_lib.is_multiprocess(mesh):
+                if sharding == "row" and mesh.devices.size > 1:
                     # No ascontiguousarray here: shard_rows slices per
                     # shard (and makes each block contiguous itself), so
                     # the one big host copy the replicated path pays is
@@ -341,6 +347,202 @@ def sharded_pool_gather(images, ids, mesh, labels=None):
         lambda im, lb, idv: (local_gather(im, idv), local_gather(lb, idv)),
         mesh=mesh, in_specs=(img_spec, P(axis), P()),
         out_specs=(img_spec, P(axis)), check_rep=False)(images, labels, ids)
+
+
+# The incremental row update's FIXED window width (rows): every drain,
+# whatever its size, applies as a sequence of exactly-this-wide blocks
+# (the tail block slides back over already-current rows, an identity
+# rewrite), so ONE jitted updater per (layout, entry shape) covers
+# every drain — a 1000-row append can never compile a fresh width
+# inside a warm round.
+UPDATE_BLOCK_FLOOR = 64
+
+
+def _update_runner(cache: Dict, mesh, sharded: bool, width: int
+                   ) -> Callable:
+    """Jitted in-place row updater for a pinned pool entry, one per
+    (layout, window width), cached beside the gather runners: a
+    ``[width, ...]`` host block lands at row ``lo`` of the resident
+    array via ``dynamic_update_slice`` — the ONLY image bytes that
+    cross the host->device boundary on an in-extent streaming drain.
+    Replicated entries donate the old buffer (XLA updates in place);
+    row-sharded entries scatter each block row to its owning shard
+    (local index math + mode="drop", no collectives) — donation is
+    skipped there, matching the sharded k-center jits (XLA:CPU rejects
+    donating sharded buffers with a per-call warning)."""
+    key = ("update_rows", bool(sharded), int(width))
+    with _CACHE_LOCK:
+        steps = cache.setdefault("steps", {})
+        if key in steps:
+            return steps[key]
+    axis = mesh_lib.DATA_AXIS
+
+    if sharded:
+
+        @jax.jit
+        def run(images, block, lo):
+            def body(img, blk, lo_):
+                rows = img.shape[0]
+                off = (jax.lax.axis_index(axis) * rows).astype(jnp.int32)
+                gidx = lo_.astype(jnp.int32) + jnp.arange(
+                    blk.shape[0], dtype=jnp.int32)
+                # Off-shard rows park PAST the shard (rows) so
+                # mode="drop" discards them — the _owned_or_oob rule
+                # (a bare gidx - off would wrap negative indices).
+                loc = jnp.where((gidx >= off) & (gidx < off + rows),
+                                gidx - off, rows)
+                return img.at[loc].set(blk, mode="drop")
+
+            spec = P(axis, *([None] * (images.ndim - 1)))
+            return shard_map(body, mesh=mesh, in_specs=(spec, P(), P()),
+                             out_specs=spec,
+                             check_rep=False)(images, block, lo)
+    else:
+
+        @functools.partial(
+            jax.jit, donate_argnums=(0,),
+            out_shardings=mesh_lib.replicated_sharding(mesh))
+        def run(images, block, lo):
+            return jax.lax.dynamic_update_slice(
+                images, block, (lo,) + (0,) * (images.ndim - 1))
+
+    with _CACHE_LOCK:
+        return steps.setdefault(key, run)
+
+
+def update_rows(cache: Optional[Dict], dataset: Any, mesh,
+                row_lo: int, row_hi: int) -> bool:
+    """Incrementally refresh a PINNED pool entry after a streaming
+    drain that appended rows (or attached labels) WITHOUT growing the
+    extent: rows ``[row_lo, row_hi)`` ride h2d as a sequence of
+    fixed-width blocks ``dynamic_update_slice``'d into the resident
+    array IN PLACE (the tail block slides back over already-current
+    rows — an identity rewrite — so every dispatch has the ONE
+    prewarmed shape); the pinned extent is never re-uploaded.  Labels
+    re-upload whole (a [capacity]-int32 device_put: tiny, never a
+    compile) so label-only records are covered by the same call, and
+    they upload BEFORE the first donating image dispatch — a transient
+    label-upload failure leaves the entry untouched and valid.
+    Returns False when the entry is not pinned or smaller than one
+    window — the caller falls back to ``release`` + re-upload (the
+    extent-boundary path).  A failure INSIDE the donating image
+    update drops the entry before re-raising: the old buffer may
+    already be consumed, and a cache entry pointing at a deleted
+    array would poison every retry (the next access re-uploads
+    instead).
+
+    Caller contract: a drain point with no in-flight consumers of the
+    entry's arrays (the stream service's single mutation point) — the
+    replicated form DONATES the old buffer."""
+    images = getattr(dataset, "images", None)
+    if not isinstance(images, np.ndarray):
+        return False
+    n = len(dataset)
+    key = (id(images), n)
+    with _CACHE_LOCK:
+        entry = cache.get("images", {}).get(key) if cache else None
+    if entry is None:
+        return False
+    _, images_dev, _ = entry
+    sharded = mesh_lib.is_row_sharded(images_dev)
+    width = int(row_hi) - int(row_lo)
+    block_rows = UPDATE_BLOCK_FLOOR
+    if width > 0 and block_rows > n:
+        return False
+    # Labels FIRST, under the ONE upload RetryPolicy: no donation is
+    # involved, so a transient H2D failure retries (and a final failure
+    # propagates) with the entry still intact and valid.
+    def _labels():
+        if sharded:
+            return mesh_lib.shard_rows(
+                dataset.targets[:n].astype(np.int32), mesh)
+        return mesh_lib.replicate(
+            dataset.targets[:n].astype(np.int32), mesh)
+
+    new_labels = _UPLOAD_RETRY.call(_labels)
+    new_images = images_dev
+    if width > 0:
+        run = _update_runner(cache, mesh, sharded, block_rows)
+        try:
+            for lo0 in range(int(row_lo), int(row_hi), block_rows):
+                lo = min(lo0, n - block_rows)
+                block = np.ascontiguousarray(images[lo:lo + block_rows])
+                new_images = run(new_images, block, jnp.int32(lo))
+        except Exception:
+            # The old buffer may be donated-and-gone: drop the entry so
+            # the next access re-uploads cleanly instead of dispatching
+            # against a deleted array forever.
+            release(cache, dataset)
+            raise
+    with _CACHE_LOCK:
+        images_map = cache.get("images", {})
+        if key not in images_map:
+            return False
+        images_map[key] = (dataset, new_images, new_labels)
+        lru = cache.setdefault("lru", [])
+        if key in lru:
+            lru.remove(key)
+        lru.append(key)
+        cache.setdefault("update_warm",
+                         set()).add((sharded, images_dev.shape))
+    return True
+
+
+def prewarm_update(cache: Optional[Dict], dataset: Any, mesh) -> bool:
+    """Build + warm the incremental updater for ``dataset``'s pinned
+    entry by dispatching it once against a THROWAWAY zeros array of the
+    entry's exact shape/layout — so the first real in-extent drain
+    dispatches a warm executable instead of paying a compile inside a
+    warm round (the jit-delta-0 contract, tests/test_compile_reuse.py).
+    The stream service calls this right after each round, landing the
+    compile in that round's (already-taxed) window.  Deliberately
+    touches NEITHER the entry nor its buffers: the pipelined round's
+    speculative scorer may still hold the live array, and a donating
+    identity update here would delete it out from under that thread
+    (update_rows' no-in-flight-consumers contract is the DRAIN point's
+    to establish, not this warm-up's).  A TRUE no-op once the (layout,
+    entry shape) pair is warmed — the marker re-arms after extent
+    growth (same jit, new shape trace) and skips everything (no h2d,
+    no dispatch) otherwise.  False when the entry is not pinned or too
+    small to ever use the updater."""
+    images = getattr(dataset, "images", None)
+    if cache is None or not isinstance(images, np.ndarray) \
+            or len(dataset) < UPDATE_BLOCK_FLOOR:
+        return False
+    key = (id(images), len(dataset))
+    with _CACHE_LOCK:
+        entry = cache.get("images", {}).get(key)
+        if entry is None:
+            return False
+        images_dev = entry[1]
+        sharded = mesh_lib.is_row_sharded(images_dev)
+        marker = (sharded, images_dev.shape)
+        if marker in cache.get("update_warm", set()):
+            return True
+    run = _update_runner(cache, mesh, sharded, UPDATE_BLOCK_FLOOR)
+    dummy = _dummy_like(images_dev, mesh, sharded)
+    block = np.zeros((UPDATE_BLOCK_FLOOR, *images_dev.shape[1:]),
+                     images_dev.dtype)
+    run(dummy, block, jnp.int32(0))  # warmed; the dummy is garbage now
+    with _CACHE_LOCK:
+        cache.setdefault("update_warm", set()).add(marker)
+    return True
+
+
+def _dummy_like(images_dev, mesh, sharded: bool):
+    """Device-side zeros in a pinned entry's exact shape/dtype/layout —
+    the warm-up stand-in prewarm_update dispatches the updater against.
+    Built ON DEVICE (``jnp.zeros`` under an out_shardings-pinned jit):
+    a host-side zeros of a multi-GB pool would transiently double the
+    host allocation AND pay pool-scale H2D per device just to warm an
+    executable.  Compiles once per (layout, shape) — exactly the
+    cadence prewarm runs it (the marker gates re-entry), inside the
+    already-taxed round window."""
+    sharding = (mesh_lib.row_sharding(mesh) if sharded
+                else mesh_lib.replicated_sharding(mesh))
+    return jax.jit(
+        functools.partial(jnp.zeros, images_dev.shape, images_dev.dtype),
+        out_shardings=sharding)()
 
 
 def release(cache: Optional[Dict], dataset: Any) -> bool:

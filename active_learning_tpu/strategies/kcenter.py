@@ -86,6 +86,13 @@ LAST_BACKEND: Optional[str] = None
 # ("replicated" / "row") — the bench's pool_sharding attribution.
 LAST_SHARDING: Optional[str] = None
 
+# Whether the last kcenter_greedy call fed its initial-min/minimax
+# column scans through the ring-permute feed (the row-sharded backend's
+# only column feed since ISSUE 15) — the bench's ring_feed attribution
+# on al_round lines.  None until a call runs; False on the replicated
+# backend.
+LAST_RING_FEED: Optional[bool] = None
+
 # Each pick's squared distance-to-(labeled ∪ earlier picks) AT PICK
 # TIME, host float32 aligned with the last kcenter_greedy call's return
 # (NaN marks the once-per-experiment minimax/uniform seed, which has no
@@ -392,7 +399,6 @@ def _build_sharded_fns(mesh, nf: int):
     ndev = mesh.devices.size
     fspec = tuple(P(axis, None) for _ in range(nf))
     vec, rep = P(axis), P()
-    repf = tuple(rep for _ in range(nf))
 
     def _offset(rows: int, dtype=jnp.int32):
         return (jax.lax.axis_index(axis) * rows).astype(dtype)
@@ -456,27 +462,78 @@ def _build_sharded_fns(mesh, nf: int):
         d = sqn[:, None] + csqn[None, :] - 2.0 * d
         return jnp.minimum(min_dist, jnp.min(d, axis=1))
 
-    def _chunk_body(factors, sqn, cfactors, min_dist):
-        # Initial-min fold for one labeled chunk whose factor rows ride
-        # in replicated (host-sliced — the caller owns the host copy of
-        # the factors; this never materializes DEVICE state on host).
-        csqn = None
-        for cf in cfactors:
-            s = jnp.sum(cf * cf, axis=1)
-            csqn = s if csqn is None else csqn * s
-        return _strip_min(factors, sqn, cfactors, csqn, min_dist)
+    def _ring_min_body(factors, sqn, cidx, cvalid, min_dist):
+        # The ring column feed's initial-min fold (DESIGN.md §15): the
+        # [L] global labeled-center ids arrive replicated
+        # (scoring.ring_center_layout — host index math, never a factor
+        # byte); each shard owner-gathers ITS contiguous L/ndev slice
+        # of center rows ONCE (mesh_lib.owner_rows — batch-sized,
+        # exact), then the blocks rotate around the ring
+        # (mesh_lib.ring_shift), each hop folding one shard-local
+        # [rows/ndev, L/ndev] distance strip into the running min.  No
+        # host column-block uploads, no replicated broadcast; min folds
+        # are exact, so the result is bit-identical to the replicated
+        # chunk scan.  Pad ids (sentinel, owned by nobody) gather as
+        # zero rows and their columns mask to +inf.  The starting
+        # blocks are seeded by masked PSUM-SCATTER (owner_rows'
+        # reduce-scatter twin): every shard passes the same replicated
+        # cidx, contributes the center rows it owns, and receives ITS
+        # L/ndev slice of the assembled result — 1/ndev the wire of a
+        # full owner_rows broadcast.  (A per-shard-different id slice
+        # through owner_rows would cross-sum different gathers — the
+        # bug class owner_rows_scattered exists to prevent.)
+        lb = cidx.shape[0] // ndev
+        me = jax.lax.axis_index(axis)
+        vb = jax.lax.dynamic_slice_in_dim(cvalid, me * lb, lb, 0)
+        crows = tuple(mesh_lib.owner_rows_scattered(f, cidx, axis)
+                      for f in factors)
+        csqn = mesh_lib.owner_rows_scattered(sqn, cidx, axis)
 
-    def _minimax_block_body(factors, sqn, row_max, cfactors):
-        csqn = None
-        for cf in cfactors:
-            s = jnp.sum(cf * cf, axis=1)
-            csqn = s if csqn is None else csqn * s
-        d = None
-        for f, cf in zip(factors, cfactors):
-            dd = f @ cf.T
-            d = dd if d is None else d * dd
-        d = sqn[:, None] + csqn[None, :] - 2.0 * d
-        return jnp.maximum(row_max, jnp.max(d, axis=1))
+        def hop(_, carry):
+            min_dist, crows, csqn, vb = carry
+            d = None
+            for f, r in zip(factors, crows):
+                dd = f @ r.T
+                d = dd if d is None else d * dd
+            d = sqn[:, None] + csqn[None, :] - 2.0 * d
+            d = jnp.where(vb[None, :] > 0, d, jnp.inf)
+            min_dist = jnp.minimum(min_dist, jnp.min(d, axis=1))
+            crows, csqn, vb = mesh_lib.ring_shift((crows, csqn, vb),
+                                                  ndev, axis)
+            return (min_dist, crows, csqn, vb)
+
+        min_dist, _, _, _ = jax.lax.fori_loop(
+            0, ndev, hop, (min_dist, crows, csqn, vb))
+        return min_dist
+
+    def _ring_minimax_body(factors, sqn, valid):
+        # The minimax seed's all-pairs row-max over the SAME ring feed:
+        # each shard's own factor block (with its sqn + validity)
+        # rotates around the ring, folding a shard-local
+        # [rows/ndev, rows/ndev] strip max per hop — after ndev hops
+        # every real column has been seen exactly once.  Pad rows mask
+        # to -inf as COLUMNS here (they must not lower any row's max);
+        # as ROWS they are masked to +inf by _argmin_body.  Max folds
+        # are exact, so the seed is the replicated seed.
+        rows = sqn.shape[0]
+        row_max0 = jnp.full((rows,), -jnp.inf)
+
+        def hop(_, carry):
+            row_max, block, bsqn, bvalid = carry
+            d = None
+            for f, bf in zip(factors, block):
+                dd = f @ bf.T
+                d = dd if d is None else d * dd
+            d = sqn[:, None] + bsqn[None, :] - 2.0 * d
+            d = jnp.where(bvalid[None, :] > 0, d, -jnp.inf)
+            row_max = jnp.maximum(row_max, jnp.max(d, axis=1))
+            block, bsqn, bvalid = mesh_lib.ring_shift(
+                (block, bsqn, bvalid), ndev, axis)
+            return (row_max, block, bsqn, bvalid)
+
+        row_max, _, _, _ = jax.lax.fori_loop(
+            0, ndev, hop, (row_max0, factors, sqn, valid))
+        return row_max
 
     def _argmin_body(row_max, valid):
         # Pad rows (valid 0) forced to +inf so they can never win the
@@ -590,18 +647,17 @@ def _build_sharded_fns(mesh, nf: int):
                                                    selectable, key)
 
     @jax.jit
-    def min_chunk(factors, sqn, cfactors, min_dist):
+    def ring_min(factors, sqn, cidx, cvalid, min_dist):
         return shard_map(
-            _chunk_body, mesh=mesh, in_specs=(fspec, vec, repf, vec),
-            out_specs=vec, check_rep=False)(factors, sqn, cfactors,
-                                            min_dist)
+            _ring_min_body, mesh=mesh,
+            in_specs=(fspec, vec, rep, rep, vec), out_specs=vec,
+            check_rep=False)(factors, sqn, cidx, cvalid, min_dist)
 
     @jax.jit
-    def minimax_block(factors, sqn, row_max, cfactors):
+    def ring_minimax(factors, sqn, valid):
         return shard_map(
-            _minimax_block_body, mesh=mesh,
-            in_specs=(fspec, vec, vec, repf), out_specs=vec,
-            check_rep=False)(factors, sqn, row_max, cfactors)
+            _ring_minimax_body, mesh=mesh, in_specs=(fspec, vec, vec),
+            out_specs=vec, check_rep=False)(factors, sqn, valid)
 
     @jax.jit
     def argmin_valid(row_max, valid):
@@ -609,7 +665,7 @@ def _build_sharded_fns(mesh, nf: int):
                          out_specs=rep, check_rep=False)(row_max, valid)
 
     return {"scan_batched": scan_batched, "scan_q1": scan_q1,
-            "min_chunk": min_chunk, "minimax_block": minimax_block,
+            "ring_min": ring_min, "ring_minimax": ring_minimax,
             "argmin_valid": argmin_valid}
 
 
@@ -643,15 +699,22 @@ def _kcenter_greedy_sharded(factors_np: Tuple[np.ndarray, ...],
     residency of rows/ndev.  The factors arrive as HOST arrays and are
     uploaded per shard straight into the row sharding
     (mesh_lib.shard_rows) — the full matrix never materializes on any
-    one device nor a second (padded) time on host; the host copy also
-    feeds the initial min pass and the minimax seed their replicated
-    [chunk, D] column blocks (index math + slicing only, no device
-    round-trips)."""
+    one device nor a second (padded) time on host (and on a
+    multi-process mesh each host uploads only its own row range).  The
+    initial min pass and the minimax seed feed their column blocks over
+    the ring-permute feed (mesh_lib.ring_shift, DESIGN.md §15): blocks
+    rotate device-to-device around the mesh instead of riding host
+    uploads + replicated broadcast — the only host work left is the
+    center-id layout (scoring.ring_center_layout, index math only)."""
+    from . import scoring
+
     n = labeled_mask.shape[0]
     n_pad = bucket_size(n, floor=POOL_BUCKET_FLOOR)
     ndev = mesh.devices.size
     fns = _sharded_jits(mesh, len(factors_np))
     vec_sh = jax.sharding.NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+    global LAST_RING_FEED
+    LAST_RING_FEED = True
 
     # Per-shard upload straight into the row sharding (shard_rows with
     # rows=n_pad): the bucket pad materializes only on the tail shard's
@@ -671,22 +734,17 @@ def _kcenter_greedy_sharded(factors_np: Tuple[np.ndarray, ...],
         if randomize:
             seed_idx = int(rng.integers(n))
         else:
-            # Sharded minimax seed: fold host-sliced column blocks (the
-            # SAME wraparound block layout as _minimax_row) into a
-            # sharded row_max, then a global argmin with pad rows
-            # masked to +inf.  max/min folds are exact, so the seed is
-            # the replicated seed.
-            block = 2048
-            order = np.arange(n + ((-n) % block)) % n
+            # Sharded minimax seed over the RING feed: each shard's own
+            # factor block rotates around the mesh, folding a local
+            # strip max per hop (_ring_minimax_body), then a global
+            # argmin with pad rows masked to +inf.  max/min folds are
+            # exact, so the seed is the replicated seed — with zero
+            # host column uploads.
             valid = np.zeros(n_pad, np.float32)
             valid[:n] = 1.0
-            row_max = jax.device_put(
-                np.full(n_pad, -np.inf, np.float32), vec_sh)
-            for cols in order.reshape(-1, block):
-                cf = tuple(f[cols] for f in factors_np)
-                row_max = fns["minimax_block"](factors, sqn, row_max, cf)
-            seed_idx = int(fns["argmin_valid"](
-                row_max, jax.device_put(valid, vec_sh)))
+            valid_dev = jax.device_put(valid, vec_sh)
+            row_max = fns["ring_minimax"](factors, sqn, valid_dev)
+            seed_idx = int(fns["argmin_valid"](row_max, valid_dev))
         picks_pre.append(seed_idx)
         labeled_idxs = np.asarray([seed_idx])
         budget -= 1
@@ -695,18 +753,17 @@ def _kcenter_greedy_sharded(factors_np: Tuple[np.ndarray, ...],
                              None, len(picks_pre))
     q = max(1, min(q, budget))
 
-    # Initial min pass: labeled chunks ride in as replicated host-sliced
-    # factor rows (fixed [1024, D] shape — reused across rounds), the
-    # [rows/ndev, 1024] strip and min fold run shard-local.
-    chunk_size = 1024
+    # Initial min pass over the RING column feed: the labeled-center
+    # ids ride in replicated on a bucketed layout (index math only —
+    # scoring.ring_center_layout), each shard owner-gathers its slice
+    # of center rows once, and the blocks rotate around the mesh while
+    # every shard folds [rows/ndev, L/ndev] strips into its running
+    # min (_ring_min_body).  The pad sentinel n_pad is owned by no
+    # shard, so pad columns gather as zeros and mask to +inf.
+    cidx, cvalid = scoring.ring_center_layout(labeled_idxs, n_pad, ndev)
     min_dist = jax.device_put(np.full(n_pad, np.inf, np.float32), vec_sh)
-    for start in range(0, len(labeled_idxs), chunk_size):
-        chunk = labeled_idxs[start:start + chunk_size]
-        if len(chunk) < chunk_size:  # pad with repeats: min is unaffected
-            chunk = np.concatenate(
-                [chunk, np.repeat(chunk[:1], chunk_size - len(chunk))])
-        cf = tuple(f[chunk] for f in factors_np)
-        min_dist = fns["min_chunk"](factors, sqn, cf, min_dist)
+    min_dist = fns["ring_min"](factors, sqn, jnp.asarray(cidx),
+                               jnp.asarray(cvalid), min_dist)
 
     selectable = np.zeros(n_pad, dtype=np.float32)
     selectable[:n] = 1.0
@@ -747,8 +804,10 @@ def row_capable(n: int, budget: int, mesh, batch_q: Optional[int] = None,
     q = 1 if randomize else int(batch_q or DEFAULT_BATCH_Q)
     q = max(1, min(q, budget))
     n_pad = bucket_size(n, floor=POOL_BUCKET_FLOOR)
-    return (ndev > 1 and not mesh_lib.is_multiprocess(mesh)
-            and n_pad % ndev == 0 and n_pad // ndev >= q)
+    # Multi-process meshes qualify since the pod tier (DESIGN.md §15):
+    # the collective backend's shard_map programs run identically over
+    # DCN, and the factor upload assembles per process (shard_rows).
+    return ndev > 1 and n_pad % ndev == 0 and n_pad // ndev >= q
 
 
 def kcenter_greedy(
@@ -787,7 +846,7 @@ def kcenter_greedy(
     q = 1 if randomize else int(batch_q or DEFAULT_BATCH_Q)
     q = max(1, min(q, budget))
 
-    global LAST_SHARDING
+    global LAST_SHARDING, LAST_RING_FEED
     use_row = (pool_sharding != "replicated"
                and row_capable(n, budget, mesh, batch_q=batch_q,
                                randomize=randomize))
@@ -798,6 +857,7 @@ def kcenter_greedy(
         return _kcenter_greedy_sharded(factors_np, labeled_mask, budget,
                                        randomize, rng, q, key, mesh)
     LAST_SHARDING = "replicated"
+    LAST_RING_FEED = False
 
     factors = tuple(jnp.asarray(np.asarray(f), dtype=jnp.float32)
                     for f in factors)

@@ -21,7 +21,7 @@ scores.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +30,7 @@ import numpy as np
 from ..data.augment import apply_view
 from ..data.core import Dataset, ViewSpec
 from ..parallel import mesh as mesh_lib
+from ..pool import bucket_size
 from ..data.pipeline import (batch_index_lists, iterate_batches,
                              padded_batch_layout)
 
@@ -62,6 +63,37 @@ def batched_min_dist_update(factors, sqn: jnp.ndarray,
     d = (sqn[:, None] + sqn[center_idxs][None, :]
          - 2.0 * dots_to_many(factors, center_idxs))
     return jnp.minimum(min_dist, jnp.min(d, axis=1))
+
+
+# Bucket floor for the ring column feed's center-id plan: labeled sets
+# grow round over round, so the padded length rides the pool bucket
+# ladder — round N+1 reuses round N's ring executables until the
+# labeled count crosses a bucket boundary.
+RING_CENTER_FLOOR = 1024
+
+
+def ring_center_layout(center_idxs: np.ndarray, sentinel: int,
+                       ndev: int, floor: int = RING_CENTER_FLOOR
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """The ring column feed's center-block plan (DESIGN.md §15) — the
+    column analogue of ``chunk_row_slices``: the [L] global labeled-
+    center ids padded up to a ``pool.bucket_size`` ladder length
+    rounded to divide the mesh, id-padded with ``sentinel`` (an index
+    no shard owns, so ``mesh_lib.owner_rows`` returns exact zeros for
+    it) and masked via the returned validity vector.  Shard i of the
+    ring starts with the contiguous slice ``[i*L/ndev, (i+1)*L/ndev)``
+    of this layout; after ndev ring hops every shard has folded every
+    valid center exactly once.  Host index math only — never a factor
+    byte (the whole point: the ring feed replaced the host column-block
+    uploads)."""
+    idxs = np.asarray(center_idxs, dtype=np.int32)
+    l_pad = bucket_size(max(1, len(idxs)), floor=floor)
+    l_pad += (-l_pad) % max(1, int(ndev))
+    cidx = np.full(l_pad, int(sentinel), dtype=np.int32)
+    cidx[:len(idxs)] = idxs
+    cvalid = np.zeros(l_pad, dtype=np.float32)
+    cvalid[:len(idxs)] = 1.0
+    return cidx, cvalid
 
 
 def make_prob_stats_step(model, view: ViewSpec) -> Callable:

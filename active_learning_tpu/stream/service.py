@@ -165,6 +165,32 @@ class StreamService:
         try:
             start_round = self._build(journal, resuming, preempted_round0)
             strategy = self.strategy
+            # The streaming-aware run report (DESIGN.md §13 + §14):
+            # the driver's per-round label-efficiency rows, each joined
+            # by a ``stream`` block — ingest totals, the trigger cause,
+            # WAL backlog, ack latency — so `report` renders what the
+            # SERVICE did between rounds, not just what the rounds
+            # cost.  Atomic per round, resume-merged like the driver's.
+            self._report_path = os.path.join(cfg.log_dir,
+                                             diag_lib.RUN_REPORT_FILE)
+            self._write_report = (mesh_lib.is_coordinator()
+                                  and cfg.enable_metrics)
+            self._report_rows = []
+            self._report_wall_base = 0.0
+            if self._write_report and start_round > 0:
+                self._report_rows, self._report_wall_base = \
+                    diag_lib.resume_report_rows(self._report_path,
+                                                cfg.exp_hash,
+                                                start_round)
+            self._report_header = {
+                "exp_name": cfg.exp_name, "exp_hash": cfg.exp_hash,
+                "strategy": cfg.strategy, "dataset": cfg.dataset,
+                "model": cfg.model, "run_seed": cfg.run_seed,
+                "round_budget": cfg.round_budget,
+                "init_pool_size": cfg.resolved_init_pool_size(),
+                "stream": True,
+            }
+            self._run_t0 = time.monotonic()
             pipeline_mode = pipeline_lib.resolve_round_pipeline(
                 cfg.round_pipeline, strategy.mesh)
             if pipeline_mode == "speculative":
@@ -392,6 +418,7 @@ class StreamService:
                 self._drain()
                 ladder.relax(rd)
                 snapshot = _round_snapshot(strategy)
+                t_round0 = time.monotonic()
                 for attempt in range(ladder.max_attempts()):
                     try:
                         self._run_round(rd, attempt, cause, journal,
@@ -410,10 +437,29 @@ class StreamService:
                         if ladder.escalate(exc, rd) is None:
                             raise
                         _restore_round_snapshot(strategy, snapshot, rd)
+                # Warm the incremental row updater against the freshly
+                # (re-)pinned pool BEFORE this round's jit-delta read:
+                # its one compile lands in the round that already paid
+                # the pin/growth tax, so the first in-extent drain
+                # dispatches warm and rounds after an append stay at
+                # delta 0 (tests/test_compile_reuse.py).  Best-effort:
+                # a failed warm-up (a transient dummy allocation at the
+                # HBM budget edge, say) costs one compile at the next
+                # drain, never the service.
+                try:
+                    resident_lib.prewarm_update(
+                        strategy.trainer.resident_pool, self._al_sd,
+                        strategy.mesh)
+                except Exception:  # noqa: BLE001 - warm-up only
+                    self.logger.warning(
+                        "stream: incremental-updater warm-up failed; "
+                        "the first in-extent drain will pay its "
+                        "compile", exc_info=True)
                 _emit_round_telemetry(telemetry, sink, rd, strategy,
                                       ladder,
                                       retries_baseline=run_retries0)
                 self._emit_stream_gauges(telemetry, sink, rd, cause)
+                self._write_report_row(rd, cause, t_round0)
                 # What the outgoing checkpoint scored over its ingest
                 # window becomes the drift reference for the new one —
                 # the ServeScoreDrift hot-reload semantics, driven by
@@ -505,10 +551,21 @@ class StreamService:
             return 0
         faults.site("stream_drain")
         strategy = self.strategy
+        if strategy.pipeline is not None:
+            # Quiesce the speculative scorer BEFORE any pool mutation:
+            # the incremental update DONATES the pinned buffer (a
+            # dispatch against a deleted array would kill the scorer
+            # thread), and the appended rows invalidate the speculative
+            # plan regardless — disarm waits out the in-flight chunk,
+            # establishing update_rows' no-in-flight-consumers
+            # contract; the next round re-arms.
+            strategy.pipeline.disarm()
         pool = strategy.pool
         appended = 0
         oracle_ids = []
         label_batches = []
+        pre_capacity = self.store.capacity
+        pre_rows = self.store.n_rows
         for rec in records:
             if rec.get("kind") == "pool":
                 ids = self.store.apply_pool_record(rec)
@@ -517,19 +574,48 @@ class StreamService:
                     oracle_ids.append(ids)
             else:
                 label_batches.append(self.store.apply_label_record(rec))
-        # The device copy (rows AND labels) is stale the moment records
-        # land: drop the pinned entry so the round re-uploads.  Same
-        # extent shape -> re-upload only, zero compiles (pinned in
-        # tests/test_compile_reuse.py).
-        resident_lib.release(strategy.trainer.resident_pool, self._al_sd)
-        resident_lib.release(strategy.trainer.resident_pool,
-                             self._train_sd)
+        trainer = strategy.trainer
+        grew = self.store.capacity != pre_capacity
+        if grew:
+            # Extent boundary: the pinned SHAPE changed — drop the
+            # entries so the round re-uploads at the new extent (at
+            # most one growth tax per boundary, pinned in
+            # tests/test_compile_reuse.py).
+            resident_lib.release(trainer.resident_pool, self._al_sd)
+            resident_lib.release(trainer.resident_pool, self._train_sd)
         if appended:
             pool.grow(self.store.capacity)
             for ids in oracle_ids:
                 pool.mark_valid(ids)
             self._al_sd.refresh()
             self._train_sd.refresh()
+        if not grew:
+            # In-extent drain: ONLY the new rows ride h2d — fixed-width
+            # dynamic_update_slice blocks into the pinned extent
+            # (labels re-upload whole: a tiny device_put, which also
+            # covers label-only records) instead of dropping +
+            # re-uploading the whole pinned pool per drain (the
+            # ROADMAP item 3 remnant this closes).  The al/train views
+            # share storage, so ONE update covers both consumers; an
+            # entry not pinned yet, a pool smaller than one window, OR
+            # any update failure (update_rows already dropped the
+            # possibly-donated entry) falls back to the release +
+            # re-upload path — where the round's pool_arrays re-pins
+            # under the ONE upload RetryPolicy and the degradation
+            # ladder, exactly like the pre-incremental behavior.
+            try:
+                updated = resident_lib.update_rows(
+                    trainer.resident_pool, self._al_sd, strategy.mesh,
+                    pre_rows, self.store.n_rows)
+            except Exception:  # noqa: BLE001 - fall back, never crash
+                self.logger.exception(
+                    "stream: incremental resident update failed; "
+                    "falling back to release + re-upload")
+                updated = False
+            if not updated:
+                resident_lib.release(trainer.resident_pool, self._al_sd)
+                resident_lib.release(trainer.resident_pool,
+                                     self._train_sd)
         for ids, _labels in label_batches:
             fresh = ids[~pool.labeled[ids]]
             # Defense in depth behind the handler's 400 guard: a WAL
@@ -618,6 +704,44 @@ class StreamService:
             stream_rounds_run=self.rounds_run,
             stream_last_trigger_cause=self.last_trigger["cause"],
             stream_last_trigger_ts=self.last_trigger["ts"])
+
+    def _write_report_row(self, rd: int, cause: str,
+                          t_round0: float) -> None:
+        """One streaming-aware run_report.json row: the driver's
+        label-efficiency fields + the ``stream`` block (ingest totals,
+        trigger cause, backlog, ack latency) — atomically rewritten per
+        round so a killed service still leaves a renderable artifact
+        (`python -m active_learning_tpu report <log_dir>`)."""
+        if not getattr(self, "_write_report", False):
+            return
+        strategy = self.strategy
+        counters = self.queue.counters()
+        lat = self.metrics.snapshot().get("latency_ms") or {}
+        now = time.monotonic()
+        row = {
+            "round": rd,
+            "labeled": int(strategy.pool.num_labeled),
+            "cumulative_budget": float(strategy.pool.cumulative_cost),
+            "test_accuracy": strategy.last_test_acc,
+            "round_time_s": round(now - t_round0, 3),
+            "wall_clock_s": round(
+                self._report_wall_base + (now - self._run_t0), 3),
+            "stream": {
+                "trigger_cause": cause,
+                "ingest_rows_total": counters["accepted_rows_total"],
+                "ingest_labels_total": counters["accepted_labels_total"],
+                "pool_rows": self.store.n_rows,
+                "wal_backlog_rows": counters["pending_rows"],
+                "ack_ms_p50": lat.get("p50"),
+                "ack_ms_p99": lat.get("p99"),
+            },
+        }
+        diag = getattr(strategy, "diagnostics", None)
+        if diag is not None:
+            row.update(diag.last_row)
+        self._report_rows.append(row)
+        diag_lib.write_run_report(self._report_path, self._report_header,
+                                  self._report_rows)
 
     def _emit_stream_gauges(self, telemetry, sink, rd: int,
                             cause: str) -> None:

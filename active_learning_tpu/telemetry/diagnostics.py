@@ -559,6 +559,27 @@ def read_run_report(path: str) -> Optional[Dict[str, Any]]:
     return payload if isinstance(payload, dict) else None
 
 
+def resume_report_rows(path: str, exp_hash: Optional[str],
+                       start_round: int
+                       ) -> tuple[List[Dict[str, Any]], float]:
+    """(completed rounds' rows merged back from a prior
+    run_report.json, cumulative wall-clock base to continue from) — THE
+    resume-merge rule, shared by the batch driver and the stream
+    service so the row filter and the monotone-wall-clock contract
+    (accuracy-vs-time must not reset to zero across a preemption) can
+    never drift between the two writers.  Empty/0.0 when no prior
+    report exists or it belongs to a different experiment."""
+    prior = read_run_report(path)
+    if not prior or prior.get("exp_hash") != exp_hash:
+        return [], 0.0
+    rows = [r for r in prior.get("rounds", [])
+            if isinstance(r, dict) and isinstance(r.get("round"), int)
+            and r["round"] < start_round]
+    base = max((float(r.get("wall_clock_s") or 0.0) for r in rows),
+               default=0.0)
+    return rows, base
+
+
 def _json_default(o: Any):
     if isinstance(o, np.ndarray):
         return o.tolist()

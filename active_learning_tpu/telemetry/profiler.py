@@ -421,6 +421,34 @@ def _shape_bytes(shape_text: str) -> int:
     return total
 
 
+def _collective_inst_re() -> "re.Pattern":
+    pattern = "|".join(re.escape(op) for op in COLLECTIVE_OPS)
+    # The async lowering emits '-start'/'-done' pairs; the -start
+    # instruction carries the payload shape (and its NAME is what the
+    # trace's hlo_op references), so the opcode match must accept it —
+    # without this, every collective on the async-lowering platforms
+    # (TPU) would land in collective_events_unattributed.
+    return re.compile(
+        rf"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*(.+?)\s+"
+        rf"({pattern})(?:-start)?\(",
+        re.M)
+
+
+def hlo_text_collective_bytes(text: str) -> Dict[str, int]:
+    """{op_name: payload bytes} from ONE optimized-HLO module's text —
+    the parsing core of ``hlo_collective_bytes``, exposed so callers
+    holding compiled executables directly (``jitted.lower(...)
+    .compile().as_text()`` — the pod-tier wire-bytes cross-check in
+    tests/test_pod_tier.py and the gradient-sync bench rider) can
+    measure collective payload bytes without arming a disk dump."""
+    table: Dict[str, int] = {}
+    for name, shape_text, _op in _collective_inst_re().findall(text):
+        nbytes = _shape_bytes(shape_text)
+        if nbytes > 0:
+            table[name] = max(table.get(name, 0), nbytes)
+    return table
+
+
 def hlo_collective_bytes(dump_dir: Optional[str]
                          ) -> Dict[Tuple[str, str], int]:
     """{(hlo_module, op_name): payload bytes} from every
@@ -432,16 +460,7 @@ def hlo_collective_bytes(dump_dir: Optional[str]
     table: Dict[Tuple[str, str], int] = {}
     if not dump_dir or not os.path.isdir(dump_dir):
         return table
-    pattern = "|".join(re.escape(op) for op in COLLECTIVE_OPS)
-    # The async lowering emits '-start'/'-done' pairs; the -start
-    # instruction carries the payload shape (and its NAME is what the
-    # trace's hlo_op references), so the opcode match must accept it —
-    # without this, every collective on the async-lowering platforms
-    # (TPU) would land in collective_events_unattributed.
-    inst_re = re.compile(
-        rf"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*(.+?)\s+"
-        rf"({pattern})(?:-start)?\(",
-        re.M)
+    inst_re = _collective_inst_re()
     for path in glob.glob(os.path.join(dump_dir,
                                        "*after_optimizations.txt")):
         try:

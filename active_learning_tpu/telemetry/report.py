@@ -47,6 +47,20 @@ _COLUMNS = (
     ("ece", lambda r: _fmt((r.get("calibration") or {}).get("ece"), 4)),
 )
 
+# Streaming-run columns, appended only when any row carries a "stream"
+# block (the stream service's run_report rows, stream/service.py): the
+# trigger cause + ingest/backlog/ack-latency joins — what the service
+# did BETWEEN rounds, beside what the rounds cost.
+_STREAM_COLUMNS = (
+    ("trigger", lambda r: (r.get("stream") or {}).get("trigger_cause")),
+    ("ingested", lambda r: _int_or_none(
+        (r.get("stream") or {}).get("ingest_rows_total"))),
+    ("backlog", lambda r: _int_or_none(
+        (r.get("stream") or {}).get("wal_backlog_rows"))),
+    ("ack_p99", lambda r: _fmt(
+        (r.get("stream") or {}).get("ack_ms_p99"), 1)),
+)
+
 
 def _fmt(v: Any, digits: int) -> Optional[str]:
     if v is None:
@@ -158,11 +172,17 @@ def _table(headers: List[str], rows: List[List[Optional[str]]]) -> str:
 
 
 def render_single(run: Dict[str, Any]) -> str:
-    rows = [[fn(r) for _, fn in _COLUMNS] for r in run["rounds"]]
+    cols = list(_COLUMNS)
+    streaming = any(isinstance(r.get("stream"), dict)
+                    for r in run["rounds"])
+    if streaming:
+        cols += list(_STREAM_COLUMNS)
+    rows = [[fn(r) for _, fn in cols] for r in run["rounds"]]
     head = (f"run report: {run_label(run)}  "
             f"(dataset={run.get('dataset')}, seed={run.get('run_seed')}, "
-            f"source={run.get('source')})")
-    return head + "\n" + _table([h for h, _ in _COLUMNS], rows)
+            + ("stream, " if streaming or run.get("stream") else "")
+            + f"source={run.get('source')})")
+    return head + "\n" + _table([h for h, _ in cols], rows)
 
 
 def accuracy_by_budget(run: Dict[str, Any]) -> Dict[int, float]:
@@ -244,6 +264,25 @@ def _selftest() -> int:
                        "strategy": strategy, "rounds": rows}, fh)
         return d
 
+    def fake_stream_run(root: str) -> str:
+        d = os.path.join(root, "stream_run")
+        os.makedirs(d)
+        rows = [{"round": i, "labeled": 16 * (i + 1),
+                 "cumulative_budget": 16 * (i + 1),
+                 "test_accuracy": 0.3 + 0.1 * i, "round_time_s": 1.0,
+                 "wall_clock_s": 2.0 * (i + 1),
+                 "stream": {"trigger_cause":
+                            ("bootstrap" if i == 0 else "watermark"),
+                            "ingest_rows_total": 64 * i,
+                            "wal_backlog_rows": 0,
+                            "ack_ms_p99": 3.5}}
+                for i in range(3)]
+        with open(os.path.join(d, RUN_REPORT_FILE), "w") as fh:
+            json.dump({"schema": 1, "exp_name": "stream_run",
+                       "strategy": "MarginSampler", "stream": True,
+                       "rounds": rows}, fh)
+        return d
+
     with tempfile.TemporaryDirectory() as root:
         a = fake_run(root, "margin_run", "MarginSampler",
                      [0.30, 0.52, 0.61])
@@ -254,6 +293,14 @@ def _selftest() -> int:
         single = render_single(ra)
         assert "margin_run[MarginSampler]" in single
         assert "0.5200" in single and "drift_psi" in single
+        # Offline runs never grow the streaming columns...
+        assert "trigger" not in single
+        # ...streaming runs render them (cause + ingest/ack joins).
+        rs = load_run(fake_stream_run(root))
+        assert rs is not None
+        stream_single = render_single(rs)
+        assert "trigger" in stream_single and "ack_p99" in stream_single
+        assert "watermark" in stream_single and "3.5" in stream_single
         table = render_compare([ra, rb])
         assert "matched" in table
         assert "0.5500 *" in table, table  # coreset wins at budget 32

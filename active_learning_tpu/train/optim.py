@@ -32,6 +32,7 @@ the optax chain (pinned in tests/test_backward.py).
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Any, Callable, Optional, Tuple
 
@@ -180,6 +181,45 @@ def make_fused_optimizer(train_cfg: TrainConfig) -> Optional[FusedSGD]:
         getattr(train_cfg, "optim_state_dtype", "f32") or "f32")
     return FusedSGD(train_cfg.optimizer.momentum,
                     train_cfg.optimizer.weight_decay, state_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Large-batch scaling (the pod tier, DESIGN.md §15).
+# ---------------------------------------------------------------------------
+
+# Gradual-warmup length for scaled batches (the large-batch ConvNet
+# scaling rules, PAPERS.md: linear LR scaling needs the first epochs
+# ramped or the run diverges at the now-k-times-larger step size).
+LARGE_BATCH_WARMUP_EPOCHS = 5
+
+
+def apply_batch_scaling(train_cfg: TrainConfig, scale: int
+                        ) -> Tuple[TrainConfig, bool]:
+    """The large-batch ConvNet scaling rules applied for a global batch
+    grown ``scale``x with the mesh (``--scale_batch auto`` passes the
+    device count): train batch x scale (the arg pool's batch becomes a
+    PER-CHIP figure), learning rate x scale (linear scaling — the
+    per-example gradient contribution to each step stays put), and the
+    cosine warmup raised to a >=5-epoch gradual ramp (clamped below
+    t_max — _cosine_lr rejects a ramp as long as the schedule).  Step
+    schedules keep their milestones: they are epoch-keyed, and epochs
+    see the same data under any batch size.  Identity at scale <= 1.
+    Returns (config, whether anything changed)."""
+    scale = int(scale)
+    if scale <= 1:
+        return train_cfg, False
+    opt = dataclasses.replace(train_cfg.optimizer,
+                              lr=train_cfg.optimizer.lr * scale)
+    sched = train_cfg.scheduler
+    if sched.name in ("cosine", "CosineAnnealingLR") and sched.t_max > 1:
+        warm = max(sched.warmup_epochs,
+                   min(LARGE_BATCH_WARMUP_EPOCHS, sched.t_max - 1))
+        sched = dataclasses.replace(sched, warmup_epochs=warm)
+    loader = dataclasses.replace(
+        train_cfg.loader_tr,
+        batch_size=train_cfg.loader_tr.batch_size * scale)
+    return dataclasses.replace(train_cfg, loader_tr=loader,
+                               optimizer=opt, scheduler=sched), True
 
 
 def _step_lr(cfg: SchedulerConfig, base_lr: float) -> Callable[[int], float]:

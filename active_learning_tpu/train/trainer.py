@@ -137,10 +137,18 @@ class Trainer:
         self.fused_tx = make_fused_optimizer(train_cfg)
         # Gradient-sync precision (parallel/mesh.resolve_grad_allreduce):
         # "f32" keeps the partitioner's bit-exact psum inside plain jit;
-        # "int8" builds the shard_map step with the EQuARX-style
-        # block-scaled quantized all-reduce (multi-device meshes only).
-        self.grad_allreduce = mesh_lib.resolve_grad_allreduce(
-            getattr(train_cfg, "grad_allreduce", "f32") or "f32", mesh)
+        # "int8"/"auto" build the shard_map step with the EQuARX-style
+        # block-scaled quantized sync (multi-device meshes only), whose
+        # WIRE form resolve_int8_wire picks per mesh: the proven
+        # all-gather form through 8 devices, the pod-tier
+        # reduce-scatter form above the crossover ("int8_rs" forces it
+        # — A/B captures and the chaos matrix).
+        _ar_mode = getattr(train_cfg, "grad_allreduce", "f32") or "f32"
+        self.grad_allreduce = mesh_lib.resolve_grad_allreduce(_ar_mode,
+                                                              mesh)
+        self.grad_sync_form = (
+            mesh_lib.resolve_int8_wire(_ar_mode, mesh)
+            if self.grad_allreduce == "int8" else None)
         self.lr_at = make_lr_schedule(train_cfg.scheduler,
                                       train_cfg.optimizer.lr)
         # Reference quirk (strategy.py:366-367): BN runs in eval mode during
@@ -416,9 +424,12 @@ class Trainer:
         built over ``shard_map`` so the gradient reduction is OURS, not
         the partitioner's: each device computes grads of its batch
         shard's slice of the global loss, then syncs them through the
-        EQuARX-style block-scaled int8 all-reduce
-        (mesh_lib.int8_allreduce, ~4x fewer wire bytes than the f32
-        psum).  BatchNorm keeps GLOBAL-batch statistics via explicitly
+        EQuARX-style block-scaled int8 sync in whichever WIRE form the
+        mesh resolved (mesh_lib.int8_allreduce on 2-8 device meshes;
+        mesh_lib.int8_reduce_scatter — the pod-tier form whose wire
+        bytes stay ~2n regardless of device count — above the
+        crossover, DESIGN.md §15).  BatchNorm keeps GLOBAL-batch
+        statistics via explicitly
         pmean'd means (the model is cloned with ``axis_name`` when it
         supports one; BN-free models run as-is).  This path is
         BOUNDED-DELTA vs the f32 step, never bit-exact — it only builds
@@ -426,6 +437,8 @@ class Trainer:
         the driver's learning probe."""
         axis = mesh_lib.DATA_AXIS
         mesh = self.mesh
+        ndev = self.n_devices
+        sync_form = self.grad_sync_form
         train_bn = self.train_bn
         apply_optimizer = self._apply_optimizer
         try:
@@ -472,7 +485,10 @@ class Trainer:
             (loss_local, new_stats), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params, state.batch_stats, x,
                                        batch["label"], weights)
-            grads = mesh_lib.int8_allreduce(grads, axis)
+            if sync_form == "reduce_scatter":
+                grads = mesh_lib.int8_reduce_scatter(grads, ndev, axis)
+            else:
+                grads = mesh_lib.int8_allreduce(grads, axis)
             loss = jax.lax.psum(loss_local, axis)
             gnorm = optax.global_norm(grads)
             params, new_opt_state = apply_optimizer(grads, state, lr)

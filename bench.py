@@ -198,8 +198,8 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # Hard bound on the ONE stdout line: the consuming harness records a
 # ~2,000-byte tail of stdout — which carries nothing but this line — so
 # the bound needs enough margin for tail-window slop, not another whole
-# line.  1900 leaves 100 bytes of margin and fits the 13-phase
-# realistic-maximal rich form (every phase cached with every optional
+# line.  1950 fits the 14-phase realistic-maximal rich form (every
+# phase cached with every optional
 # rider: the feed-hierarchy fields, unit/backend on BOTH paper-scale
 # selection phases, the sharded-ceiling probe's pool_sharding tag,
 # pipeline/overlap on both end-to-end round phases — ISSUE 7, ~90
@@ -210,12 +210,17 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # now the experiment-truth drift rider on both round phases — ISSUE
 # 13, worst case '"drift":0.NNNNNN,' x2 ≈ 36 bytes — and the streaming
 # phase — ISSUE 14: one more phase entry (~30 bytes) plus its riders,
-# worst case '"ack_p99":NNN.NNN,"trigger":"watermark",' ≈ 40 bytes)
-# without truncation; staged truncation in _compact_line still guards
-# the pathological cases.  14 phases now ride; the all-failed degraded
-# form stays under the 1750-byte tail-slop pin in
+# worst case '"ack_p99":NNN.NNN,"trigger":"watermark",' ≈ 40 bytes —
+# and the pod-tier riders — ISSUE 15: the quantized wire form on both
+# train phases ('"grad_sync":"rs",' x2 ≈ 36 bytes; grad_wire_mb stays
+# in the evidence file) plus the ring-feed tag on both round phases and
+# the maxn probe ('"ring":true,' x3 ≈ 36 bytes)) without truncation;
+# staged truncation in _compact_line still guards the pathological
+# cases.  14 phases ride; 1950 leaves ~50 bytes of tail-window slop
+# (the tail carries nothing but this line and its newline), and the
+# all-failed degraded form stays under the 1750-byte tail-slop pin in
 # tests/test_bench_json.py.  Pinned by unit tests at both extremes.
-MAX_LINE_BYTES = 1900
+MAX_LINE_BYTES = 1950
 
 
 def log(msg: str) -> None:
@@ -779,6 +784,7 @@ def run_kcenter_phase(budget: int, dim: int = 2048, pool_n: int = 50000
         "batch_q": DEFAULT_BATCH_Q,
         "backend": kc.LAST_BACKEND,
         "pool_sharding": kc.LAST_SHARDING,
+        "ring_feed": kc.LAST_RING_FEED,
         "select_sec": round(dt, 2),
         "device_kind": device_kind,
         "platform": jax.devices()[0].platform,
@@ -950,6 +956,10 @@ def run_kcenter_maxn_phase(budget: int, dim: int = 2048):
                 div = n_chips if entry["pool_sharding"] == "row" else 1
                 result["ips"] = entry["ips"]
                 result["ips_per_chip"] = round(entry["ips"] / div, 1)
+                # The column-feed attribution (ISSUE 15): row-layout
+                # headline rungs fed their initial-min/minimax columns
+                # over the ring-permute feed; replicated rungs did not.
+                result["ring_feed"] = kc.LAST_RING_FEED
 
         for n in steps:
             try:
@@ -1376,6 +1386,13 @@ def run_stream_phase(rounds: int, max_batch: int) -> dict:
     }
 
 
+def _last_ring_feed():
+    """kcenter.LAST_RING_FEED, imported lazily like every other child-
+    side touch of the package (bench parents never import jax)."""
+    from active_learning_tpu.strategies import kcenter as kc
+    return kc.LAST_RING_FEED
+
+
 def run_al_round_phase(config: str, epochs: int) -> dict:
     """One REAL end-to-end AL experiment through the production driver —
     BASELINE.md metric #1 ("AL round wall-clock"), mirroring the
@@ -1643,6 +1660,12 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
         # run quietly self-healed mid-measurement.
         "fault_retries_total": run_total("fault_retries_total"),
         "degrade_events": run_total("degrade_events"),
+        # The pod-tier column-feed rider (DESIGN.md §15): whether the
+        # measured rounds' k-center scans fed their initial-min/minimax
+        # columns over the ring-permute feed (the row-sharded backend's
+        # only column feed) — None when the strategy never ran a
+        # k-center selection.
+        "ring_feed": _last_ring_feed(),
         "total_sec": round(total_sec, 1),
         "residency": residency,
         **_model_config_fields(strategy.model),
@@ -1864,6 +1887,21 @@ def _grad_path_fields(trainer, holder, batch, n_classes, view,
         "grad_allreduce": trainer.grad_allreduce,
         "fused_optimizer": trainer.fused_tx is not None,
     }
+    if trainer.grad_allreduce == "int8":
+        # The pod-tier wire riders (DESIGN.md §15): WHICH quantized
+        # wire the step synced over (allgather vs the reduce-scatter
+        # form) and its per-device per-step wire model MB
+        # (mesh_lib.wire_model_bytes — the same table the measured
+        # collective_bytes_total cross-check in tests/test_pod_tier.py
+        # pins against the optimized HLO).
+        from active_learning_tpu.parallel import mesh as _mesh_lib
+        form = getattr(trainer, "grad_sync_form", None) or "allgather"
+        n_params = sum(int(p.size)
+                       for p in jax.tree.leaves(variables["params"]))
+        fields["grad_sync"] = form
+        fields["grad_wire_mb"] = round(
+            _mesh_lib.wire_model_bytes(form, trainer.n_devices,
+                                       n_params) / 1e6, 2)
     # The optimizer-update loop times WHICHEVER path the measured step
     # ran — fused single-pass or the optax chain — so bwd_frac never
     # attributes optimizer time to the backward (a fused-on/off A/B
@@ -2524,7 +2562,12 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                          # the realistic-maximal line past the tail
                          # bound (same rule as feed_source below; the
                          # other phases keep it in the evidence file).
-                         *((("pool_sharding", "pool_sharding"),)
+                         *((("pool_sharding", "pool_sharding"),
+                            # The pod-tier column feed (ISSUE 15):
+                            # whether the row scans fed their columns
+                            # over the ring-permute feed — a row-layout
+                            # max-N is ambiguous without it.
+                            ("ring_feed", "ring"))
                            if name == "kcenter_select_maxn" else ()),
                          # Feed attribution rides the line only where it
                          # is the phase's subject (the hierarchy
@@ -2555,16 +2598,24 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                             # 13): a timed round's score-distribution
                             # shift rides the line; the JS twin stays
                             # in the evidence file.
-                            ("rd_score_drift_psi", "drift"))
+                            ("rd_score_drift_psi", "drift"),
+                            # The pod-tier column-feed rider (ISSUE
+                            # 15): did the measured rounds' k-center
+                            # scans run the ring feed (absent when the
+                            # strategy never ran k-center).
+                            ("ring_feed", "ring"))
                            if name.startswith("al_round") else ()),
-                         # The gradient-path riders (ISSUE 10) ride only
-                         # the TRAIN phases (their subject): the
-                         # backward's share of the step and the sync
-                         # precision the number was measured under — a
-                         # train MFU claim is ambiguous without them.
+                         # The gradient-path riders (ISSUE 10 + 15)
+                         # ride only the TRAIN phases (their subject):
+                         # the backward's share of the step, the sync
+                         # precision the number was measured under,
+                         # and — when quantized — WHICH wire form
+                         # synced it and its per-step wire-model MB
+                         # (allgather vs the pod-tier reduce-scatter).
                          # opt_update_ms stays in the evidence file.
                          *((("bwd_frac", "bwd_frac"),
-                            ("grad_allreduce", "grad_ar"))
+                            ("grad_allreduce", "grad_ar"),
+                            ("grad_sync", "grad_sync"))
                            if name.endswith("_train") else ())):
             if e.get(src) is not None and dst not in c:
                 c[dst] = e[src]
@@ -2576,6 +2627,13 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                     e.get("ips_host_serial")]
             if any(v is not None for v in legs):
                 c["legs"] = legs
+        if c.get("grad_sync"):
+            # Line spelling of the wire form: "ag"/"rs" (the full
+            # spelling + grad_wire_mb stay in the evidence file — the
+            # same finer-figures rule as opt_update_ms).
+            c["grad_sync"] = {"allgather": "ag",
+                              "reduce_scatter": "rs"}.get(
+                                  c["grad_sync"], c["grad_sync"])
         if isinstance(e.get("residency"), dict) and "feed" not in c:
             # feed_source subsumes the older scoring-residency tag on
             # the line (feed == "resident" implies the pool pinned);

@@ -16,3 +16,19 @@ def owner_gather(arr, mask, axis="data"):
     # VIOLATION: psum of a where-masked operand — the one spelling of
     # the owner-gather idiom is mesh_lib.owner_rows.
     return jax.lax.psum(picked, axis)
+
+
+def owner_scatter(arr, mask, axis="data"):
+    picked = jnp.where(mask, arr, jnp.zeros((), arr.dtype))
+    # VIOLATION: psum_scatter of a where-masked operand — the one
+    # spelling of the scattered owner-gather is
+    # mesh_lib.owner_rows_scattered.
+    return jax.lax.psum_scatter(picked, axis, scatter_dimension=0,
+                                tiled=True)
+
+
+def ring_feed(block, ndev, axis="data"):
+    perm = [(i, (i + 1) % ndev) for i in range(ndev)]
+    # VIOLATION: hand-rolled ring ppermute — the ring-feed idiom's one
+    # home is parallel/mesh.ring_shift.
+    return jax.lax.ppermute(block, axis, perm=perm)

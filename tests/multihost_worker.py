@@ -34,12 +34,19 @@ def main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=nprocs, process_id=pid)
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, repo)
     sys.path.insert(0, os.path.join(repo, "tests"))
+    # Through the production rendezvous, not a bare
+    # jax.distributed.initialize: initialize_distributed arms the gloo
+    # CPU collectives a cross-process CPU mesh needs — without them
+    # XLA:CPU refuses multiprocess computations outright (the reason
+    # this smoke was red before the pod tier, ISSUE 15).
+    from active_learning_tpu.parallel import mesh as _mesh_boot
+    _mesh_boot.initialize_distributed(coordinator_address=coordinator,
+                                      num_processes=nprocs,
+                                      process_id=pid)
     import numpy as np
 
     from active_learning_tpu.data.synthetic import get_data_synthetic

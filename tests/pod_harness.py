@@ -1,0 +1,76 @@
+"""Child script for the 2-process CPU-mesh pod-tier harness
+(tests/test_pod_tier.py) — NOT a test module.
+
+Runs ONE production driver experiment over synthetic data, either as a
+single process (the reference run) or as one process of a
+jax.distributed pod over localhost (the pod-tier run:
+mesh_lib.initialize_distributed arms gloo CPU collectives, the mesh
+spans both processes' devices, the pool row-shards with per-process
+shard assembly, and the k-center scans run their collective backend
+over DCN-shaped collectives).  The parent compares the coordinator's
+experiment_state bit for bit against the single-process run.
+
+Usage: python pod_harness.py '<json config>'
+Keys: log_dir, ckpt_path, exp_hash, strategy, local_devices,
+      coordinator (optional), num_processes (optional),
+      process_id (optional), grad_allreduce (optional),
+      scale_batch (optional).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    cfg_in = json.loads(sys.argv[1])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count="
+        f"{int(cfg_in['local_devices'])}")
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, tests_dir)  # helpers.py
+    sys.path.insert(0, os.path.dirname(tests_dir))  # the package root
+
+    from active_learning_tpu.config import (ExperimentConfig,
+                                            TelemetryConfig)
+    from active_learning_tpu.data.synthetic import get_data_synthetic
+    from active_learning_tpu.experiment import arg_pools  # noqa: F401
+    from active_learning_tpu.experiment.driver import run_experiment
+    from helpers import TinyClassifier, tiny_train_config
+
+    cfg = ExperimentConfig(
+        dataset="synthetic", arg_pool="synthetic",
+        strategy=cfg_in["strategy"], rounds=2, round_budget=8,
+        n_epoch=2, early_stop_patience=2,
+        log_dir=cfg_in["log_dir"], ckpt_path=cfg_in["ckpt_path"],
+        exp_hash=cfg_in["exp_hash"], round_pipeline="off",
+        pool_sharding="row",
+        grad_allreduce=cfg_in.get("grad_allreduce"),
+        scale_batch=cfg_in.get("scale_batch"),
+        telemetry=TelemetryConfig(enabled=False),
+        coordinator_address=cfg_in.get("coordinator"),
+        num_processes=cfg_in.get("num_processes"),
+        process_id=cfg_in.get("process_id"),
+    )
+    # The SAME seeds and data on every path: bit-identity is the claim.
+    data = get_data_synthetic(n_train=96, n_test=32, num_classes=4,
+                              image_size=8, seed=5)
+    strategy = run_experiment(cfg, data=data,
+                              train_cfg=tiny_train_config(),
+                              model=TinyClassifier(num_classes=4))
+    # The claim is bit-identity OF THE ROW-SHARDED PATH — a silent
+    # replicated fallback on both sides would also compare equal, so
+    # the layout that actually ran is asserted, not assumed.
+    assert strategy.trainer.pool_sharding == "row", \
+        strategy.trainer.pool_sharding
+    if cfg_in["strategy"] == "CoresetSampler":
+        from active_learning_tpu.strategies import kcenter as kc
+        assert kc.LAST_SHARDING == "row", kc.LAST_SHARDING
+        assert kc.LAST_RING_FEED is True
+    print("POD_HARNESS_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

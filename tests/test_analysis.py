@@ -213,6 +213,16 @@ class TestFixtures:
                    for m in msgs)
         assert any("owner-gather idiom" in m for m in msgs)
 
+    def test_collective_fixture_flags_pod_tier_spellings(self):
+        """ISSUE 15's new idioms: a masked psum_scatter outside
+        owner_rows_scattered, and a hand-rolled ring ppermute outside
+        mesh_lib.ring_shift, are both findings with home-naming hints."""
+        msgs = [f.message for f in run_fixture("collective-axis")]
+        assert any("masked-psum_scatter" in m
+                   and "owner_rows_scattered" in m for m in msgs)
+        assert any("ring-permute feed spelled by hand" in m
+                   and "ring_shift" in m for m in msgs)
+
 
 class TestSuppressions:
     def _one_violation(self, tmp_path, annotation=""):

@@ -156,7 +156,8 @@ class TestDegradedModeLine:
                 feed_source="resident", feed_stall_frac=0.01,
                 round_pipeline="speculative", overlap_frac=0.31,
                 round_vs_max_phase=1.18, spec_hit_frac=1.0,
-                fault_retries_total=2, degrade_events=1),
+                fault_retries_total=2, degrade_events=1,
+                ring_feed=True),
             # n_chips stays 1 (the cache rides only when the entry's
             # hardware matches the live 1-device CPU probe); the layout
             # tag is what's being plumbed here.
@@ -164,7 +165,17 @@ class TestDegradedModeLine:
                 base, phase="kcenter_select_maxn", ips=120.0,
                 ips_per_chip=120.0, unit="picks/sec",
                 pool_sharding="row", max_n=2_560_000,
-                replicated_max_n=1_280_000, row_scale_x=2.0),
+                replicated_max_n=1_280_000, row_scale_x=2.0,
+                ring_feed=True),
+            # The pod-tier gradient-sync riders (ISSUE 15): a train
+            # capture under the quantized reduce-scatter wire rides
+            # its form on the line (short spelling); the wire-model MB
+            # stays in the evidence file with the other finer figures.
+            "resnet50_imagenet_train": dict(
+                base, phase="resnet50_imagenet_train", ips=2700.0,
+                ips_per_chip=2700.0, batch_per_chip=128,
+                bwd_frac=0.55, grad_allreduce="int8",
+                grad_sync="reduce_scatter", grad_wire_mb=51.2),
         }
         (tmp_path / "bench_cache.json").write_text(json.dumps(cache))
         proc = _run_bench(tmp_path)
@@ -198,10 +209,21 @@ class TestDegradedModeLine:
         # the measured rounds absorbed rides the degraded-mode line too.
         assert rd["retries"] == 2
         assert rd["degraded"] == 1
+        # The pod-tier column-feed rider (ISSUE 15): the measured
+        # rounds' k-center scans fed over the ring-permute feed.
+        assert rd["ring"] is True
         # The sharded-pool probe's layout attribution (ISSUE 6): a
         # row-sharded max-N claim is meaningless without the layout tag.
         assert out["phases"]["kcenter_select_maxn"][
             "pool_sharding"] == "row"
+        assert out["phases"]["kcenter_select_maxn"]["ring"] is True
+        # The quantized-wire riders (ISSUE 15): the form rides in its
+        # short line spelling; the wire-model MB stays in the evidence
+        # file.
+        tr = out["phases"]["resnet50_imagenet_train"]
+        assert tr["grad_ar"] == "int8"
+        assert tr["grad_sync"] == "rs"
+        assert "grad_wire_mb" not in tr
 
     def test_stream_round_riders_on_the_line(self, tmp_path):
         """The streaming phase's compact-line riders (ISSUE 14): the

@@ -535,6 +535,18 @@ class TestStreamExtentCompileReuse:
         strategy = box["strategy"]
         assert svc.store.n_rows == 96 + 24
         assert strategy.pool.n_pool == 128  # ONE extent, three appends
+        # The streaming-aware run report (ISSUE 15 satellite): every
+        # round left a row joined by its stream block — trigger cause,
+        # ingest totals — renderable by the `report` verb.
+        with open(os.path.join(tmp, "run_report.json")) as fh:
+            report = json.load(fh)
+        assert report.get("stream") is True
+        rows = report["rounds"]
+        assert [r["round"] for r in rows] == [0, 1, 2, 3]
+        causes = [r["stream"]["trigger_cause"] for r in rows]
+        assert causes[0] == "bootstrap"
+        assert all(c == "watermark" for c in causes[1:])
+        assert rows[-1]["stream"]["ingest_rows_total"] == 24
         deltas = {}
         with open(os.path.join(tmp, "metrics.jsonl")) as fh:
             for line in fh:

@@ -812,3 +812,183 @@ class TestLabeledGauges:
         assert parsed["al_run_rounds_triggered"][(("cause", "drift"),)] \
             == 2.0
         assert parsed["al_run_plain"][()] == 1.5
+
+
+# ---------------------------------------------------------------------------
+# Incremental resident row update (ISSUE 15 satellite: the drain stops
+# re-uploading the pinned extent)
+# ---------------------------------------------------------------------------
+
+class TestIncrementalResidentUpdate:
+    """parallel/resident.update_rows: an in-extent streaming drain
+    refreshes a PINNED pool entry by dynamic_update_slice of ONLY the
+    new rows (plus a tiny whole-labels device_put) — never a full
+    re-upload of the pinned extent, never a compile once prewarmed."""
+
+    def _pin(self, sharding):
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.parallel import resident as resident_lib
+        _, _, al_set = get_data_synthetic(n_train=96, n_test=16,
+                                          num_classes=4, image_size=8,
+                                          seed=9)
+        # A writable copy: the synthetic arrays may be shared across
+        # tests and the point here is to mutate rows in place.
+        al_set.images = al_set.images.copy()
+        al_set.targets = al_set.targets.copy()
+        mesh = mesh_lib.make_mesh()
+        cache = {}
+        resident_lib.pool_arrays(cache, al_set, mesh, sharding=sharding)
+        return cache, al_set, mesh, resident_lib, mesh_lib
+
+    @pytest.mark.parametrize("sharding", ["replicated", "row"])
+    def test_update_refreshes_rows_and_labels_in_place(self, sharding):
+        cache, ds, mesh, resident_lib, mesh_lib = self._pin(sharding)
+        rng = np.random.default_rng(0)
+        ds.images[80:96] = rng.integers(0, 255, ds.images[80:96].shape,
+                                        dtype=np.uint8)
+        ds.targets[80:96] = (ds.targets[80:96] + 1) % 4
+        assert resident_lib.update_rows(cache, ds, mesh, 80, 96)
+        key = (id(ds.images), 96)
+        _, images_dev, labels_dev = cache["images"][key]
+        got = np.asarray(images_dev)[:96]
+        np.testing.assert_array_equal(got, ds.images[:96])
+        np.testing.assert_array_equal(
+            np.asarray(labels_dev)[:96],
+            ds.targets[:96].astype(np.int32))
+        assert mesh_lib.is_row_sharded(images_dev) == (sharding == "row")
+
+    @pytest.mark.parametrize("sharding", ["replicated", "row"])
+    def test_no_full_image_reupload(self, sharding, monkeypatch):
+        """THE satellite pin: during an in-extent update no image array
+        crosses the host->device boundary through the upload primitives
+        — only the [capacity]-labels vector does (1-D).  A regression
+        back to release + re-upload would ship the whole pinned extent
+        again and fail here."""
+        cache, ds, mesh, resident_lib, mesh_lib = self._pin(sharding)
+        uploads = []
+
+        real_shard_rows = mesh_lib.shard_rows
+        real_replicate = mesh_lib.replicate
+
+        def spy_shard_rows(array, *a, **k):
+            uploads.append(np.asarray(array).ndim)
+            return real_shard_rows(array, *a, **k)
+
+        def spy_replicate(tree, *a, **k):
+            for leaf in np.asarray(tree, dtype=object).reshape(-1) \
+                    if isinstance(tree, (list, tuple)) else [tree]:
+                uploads.append(np.asarray(leaf).ndim)
+            return real_replicate(tree, *a, **k)
+
+        monkeypatch.setattr(mesh_lib, "shard_rows", spy_shard_rows)
+        monkeypatch.setattr(mesh_lib, "replicate", spy_replicate)
+        ds.images[90:96] ^= 1
+        assert resident_lib.update_rows(cache, ds, mesh, 90, 96)
+        assert uploads and all(nd == 1 for nd in uploads), uploads
+
+    def test_unpinned_entry_returns_false(self):
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.parallel import resident as resident_lib
+        _, _, al_set = get_data_synthetic(n_train=96, n_test=16,
+                                          num_classes=4, image_size=8)
+        assert not resident_lib.update_rows({}, al_set,
+                                            mesh_lib.make_mesh(), 0, 8)
+
+    def test_pool_smaller_than_one_window_falls_back(self):
+        """A pool the fixed window cannot express (fewer rows than
+        UPDATE_BLOCK_FLOOR) refuses — the caller's release + re-upload
+        path owns it (re-uploading a tiny pool is trivially cheap)."""
+        from active_learning_tpu.data.core import ArrayDataset
+        from active_learning_tpu.parallel import mesh as mesh_lib
+        from active_learning_tpu.parallel import resident as resident_lib
+        rng = np.random.default_rng(2)
+        tiny = ArrayDataset(
+            rng.integers(0, 255, (32, 8, 8, 3), dtype=np.uint8),
+            np.zeros(32, dtype=np.int64), 4,
+            get_data_synthetic(n_train=8, n_test=8)[2].view)
+        mesh = mesh_lib.make_mesh()
+        cache = {}
+        resident_lib.pool_arrays(cache, tiny, mesh)
+        assert not resident_lib.update_rows(cache, tiny, mesh, 0, 8)
+        assert not resident_lib.prewarm_update(cache, tiny, mesh)
+
+    @pytest.mark.parametrize("sharding", ["replicated", "row"])
+    def test_prewarmed_update_adds_zero_compiles(self, sharding):
+        """The delta-0 contract: prewarm_update builds + warms the ONE
+        fixed-width updater; every real in-extent drain after it —
+        narrow OR wider than the window (drains chunk into fixed-width
+        blocks) — dispatches the SAME executable, zero new compiles
+        (the in-extent rounds of TestStreamExtentCompileReuse rest on
+        this)."""
+        cache, ds, mesh, resident_lib, _ = self._pin(sharding)
+        assert resident_lib.prewarm_update(cache, ds, mesh)
+        runners = {k: v for k, v in cache["steps"].items()
+                   if isinstance(k, tuple) and k and k[0] == "update_rows"}
+        assert runners
+        sizes = {k: v._cache_size() for k, v in runners.items()}
+        ds.images[88:96] ^= 1
+        assert resident_lib.update_rows(cache, ds, mesh, 88, 96)
+        # A drain WIDER than the window must reuse the same executable
+        # too (the review finding: a watermark > window once compiled a
+        # fresh width inside a warm round).
+        ds.images[0:96] ^= 2
+        assert resident_lib.update_rows(cache, ds, mesh, 0, 96)
+        assert {k: v._cache_size() for k, v in runners.items()} == sizes
+        np.testing.assert_array_equal(
+            np.asarray(cache["images"][(id(ds.images), 96)][1])[:96],
+            ds.images[:96])
+
+    def test_prewarm_is_noop_once_warm(self, monkeypatch):
+        """Once the (layout, shape) pair is warmed, prewarm_update does
+        NOTHING — no label re-upload, no identity dispatch — so the
+        per-round service call stays free on drainless rounds."""
+        cache, ds, mesh, resident_lib, mesh_lib = self._pin("replicated")
+        assert resident_lib.prewarm_update(cache, ds, mesh)
+        calls = []
+        monkeypatch.setattr(
+            mesh_lib, "replicate",
+            lambda *a, **k: calls.append(1) or (_ for _ in ()).throw(
+                AssertionError("prewarm re-uploaded after warm")))
+        assert resident_lib.prewarm_update(cache, ds, mesh)
+        assert not calls
+
+    def test_label_upload_failure_leaves_entry_intact(self, monkeypatch):
+        """Labels upload BEFORE the donating image dispatch (and under
+        the upload RetryPolicy): a label-upload failure propagates with
+        the pinned entry untouched and still valid."""
+        cache, ds, mesh, resident_lib, mesh_lib = self._pin("replicated")
+
+        def boom(*a, **k):
+            raise RuntimeError("injected label-upload failure")
+
+        monkeypatch.setattr(mesh_lib, "replicate", boom)
+        with pytest.raises(RuntimeError, match="label-upload"):
+            resident_lib.update_rows(cache, ds, mesh, 80, 96)
+        monkeypatch.undo()
+        assert resident_lib.cached(cache, ds)
+        # The untouched entry still serves reads.
+        key = (id(ds.images), 96)
+        np.testing.assert_array_equal(
+            np.asarray(cache["images"][key][1])[:96], ds.images[:96])
+
+    def test_failed_donating_update_drops_entry(self, monkeypatch):
+        """A failure inside the donating image dispatch may have
+        consumed the old buffer: the entry must be DROPPED before the
+        exception propagates — a cache entry pointing at a deleted
+        array would poison every retry (the review finding).  The next
+        access re-uploads cleanly."""
+        cache, ds, mesh, resident_lib, _ = self._pin("replicated")
+
+        def boom(*a, **k):
+            def run(*aa, **kk):
+                raise RuntimeError("injected dispatch failure")
+            return run
+
+        monkeypatch.setattr(resident_lib, "_update_runner", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            resident_lib.update_rows(cache, ds, mesh, 80, 96)
+        assert not resident_lib.cached(cache, ds)
+        monkeypatch.undo()
+        # Recovery: the next pool_arrays call re-pins from host.
+        resident_lib.pool_arrays(cache, ds, mesh)
+        assert resident_lib.cached(cache, ds)
