@@ -1,8 +1,8 @@
 """Whole-package static analysis (DESIGN.md §12).
 
-One engine, one parse per file, 16 checks: the 10 invariants the old
+One engine, one parse per file, 17 checks: the 10 invariants the old
 ``scripts/trace_lint.py`` monolith enforced (ported verbatim — same
-verdicts, same messages) plus six deep checkers targeting the bug
+verdicts, same messages) plus seven deep checkers targeting the bug
 classes three consecutive PRs of code review kept re-finding:
 
   lock-discipline    _GUARDED_BY fields only touched under their lock
@@ -15,6 +15,9 @@ classes three consecutive PRs of code review kept re-finding:
   wal-before-ack     streaming ingest handlers append to the fsync'd
                      WAL before constructing any ack, and stay
                      host-pure (DESIGN.md §14)
+  disk-pool-paging   paging-path functions (the _PAGED_READERS
+                     registry) never materialize the whole pool store
+                     on one host (DESIGN.md §16)
 
 Entry points: ``scripts/al_lint.py`` (CLI: --check/--list/--json),
 ``scripts/trace_lint.py`` (the legacy compatibility shim), and

@@ -1,4 +1,4 @@
-"""The checker registry: 10 ported legacy checks + 6 deep checkers.
+"""The checker registry: 10 ported legacy checks + 7 deep checkers.
 
 Ordered — the CLI lists and runs them in this order, and the per-check
 fixture test parametrizes over it.  Adding a check = appending here
@@ -14,6 +14,7 @@ from .recompile import RecompileHazardChecker
 from .collective_axis import CollectiveAxisChecker
 from .diagnostics_inert import DiagnosticsInertChecker
 from .wal_before_ack import WalBeforeAckChecker
+from .disk_pool_paging import DiskPoolPagingChecker
 
 DEEP_CHECKERS = (
     LockDisciplineChecker(),
@@ -22,6 +23,7 @@ DEEP_CHECKERS = (
     CollectiveAxisChecker(),
     DiagnosticsInertChecker(),
     WalBeforeAckChecker(),
+    DiskPoolPagingChecker(),
 )
 
 CHECKERS = tuple(LEGACY_CHECKERS) + DEEP_CHECKERS

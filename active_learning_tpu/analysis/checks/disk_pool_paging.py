@@ -1,0 +1,162 @@
+"""disk-pool-paging: paging-path functions never materialize the store.
+
+The disk tier's one scaling claim — "a pool bigger than host RAM pages
+through a bounded cache" (DESIGN.md §16) — dies the moment any function
+on the paging path reads the whole extent into host memory: one
+``np.asarray(mm)`` and the demand-paged backend quietly becomes the
+in-memory backend with extra steps, OOMing exactly at the scale it
+exists for.  The spy counters in tests/test_disk_pool.py prove
+boundedness dynamically for the paths a test drives; this checker
+proves it statically for every path.
+
+The registry is closed: a module declaring ``_PAGED_READERS`` (a tuple
+of function names — data/diskpool.py) nominates the ONLY functions
+allowed to touch the disk extent, and every listed name must resolve to
+a module-level function or a method in some class body — a
+registered-but-missing reader means the registry drifted from the code.
+
+Inside each registered function, three materialization shapes are
+forbidden on any STORE-NAMED value (terminal name ``mm``/``*_mm``, or
+carrying the ``store`` word — the memmap and its aliases):
+
+  1. whole-array constructors: ``np.asarray(mm)`` / ``np.array(mm)`` /
+     ``np.ascontiguousarray(mm)`` — one call, whole pool in RAM;
+  2. the full slice ``mm[:]`` (no bounds) — same copy, subscript
+     spelling;
+  3. ``mm.copy()`` / ``mm.tolist()`` — method spellings of the same.
+
+Like the sibling checkers the walk is LEXICAL: bounded block slices
+(``mm[lo:hi]``) pass because they carry bounds, and aliases are
+recognized by name shape, not dataflow — name the memmap like a memmap.
+
+Suppression: ``# al-lint: paging-ok <reason>`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ..engine import Checker, Context
+from ..findings import Finding
+
+_MATERIALIZERS = ("asarray", "array", "ascontiguousarray")
+_COPY_METHODS = ("copy", "tolist")
+_STORE_NAME = re.compile(r"((^|_)mm$|store)", re.IGNORECASE)
+
+
+def _paged_registry(tree: ast.Module) -> Optional[List[str]]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_PAGED_READERS"
+                for t in node.targets):
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                return []
+            return [elt.value for elt in node.value.elts
+                    if isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)]
+    return None
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """The rightmost name of a Name/Attribute chain (``self._mm`` ->
+    ``_mm``), or "" for anything else."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_store_named(node: ast.AST) -> bool:
+    return bool(_STORE_NAME.search(_terminal_name(node)))
+
+
+def _registered_functions(tree: ast.Module, names: List[str]):
+    """Every def matching a registered name — module level AND inside
+    class bodies (the paging path is mostly methods)."""
+    found = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in names:
+            found.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and sub.name in names:
+                    found.setdefault(sub.name, []).append(sub)
+    return found
+
+
+class DiskPoolPagingChecker(Checker):
+    id = "disk-pool-paging"
+    title = ("paging-path functions (the _PAGED_READERS registry) never "
+             "materialize the whole pool store")
+    suppress_token = "paging-ok"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        problems: List[Finding] = []
+        for path in ctx.files:
+            tree, err = ctx.tree(path)
+            if err is not None:
+                continue  # parse failures are the legacy checks' finding
+            registry = _paged_registry(tree)
+            if registry is None:
+                continue
+            rel = ctx.rel(path)
+            fns = _registered_functions(tree, registry)
+            for name in registry:
+                if name not in fns:
+                    problems.append(Finding(
+                        check=self.id, path=rel, line=0,
+                        message=(f"_PAGED_READERS names {name!r} but no "
+                                 "function or method defines it — the "
+                                 "closed registry drifted from the code"),
+                        hint="define the reader or fix the registry"))
+                    continue
+                for fn in fns[name]:
+                    self._check_bounded(fn, rel, problems)
+        return problems
+
+    def _check_bounded(self, fn, rel, problems):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = node.func
+                if (isinstance(callee, ast.Attribute)
+                        and callee.attr in _MATERIALIZERS
+                        and node.args
+                        and _is_store_named(node.args[0])):
+                    problems.append(self._finding(
+                        fn, rel, node.lineno,
+                        f"np.{callee.attr}("
+                        f"{_terminal_name(node.args[0])}) copies the "
+                        "WHOLE store into host memory"))
+                elif (isinstance(callee, ast.Attribute)
+                        and callee.attr in _COPY_METHODS
+                        and _is_store_named(callee.value)):
+                    problems.append(self._finding(
+                        fn, rel, node.lineno,
+                        f"{_terminal_name(callee.value)}."
+                        f"{callee.attr}() materializes the whole "
+                        "store"))
+            elif (isinstance(node, ast.Subscript)
+                    and _is_store_named(node.value)
+                    and isinstance(node.slice, ast.Slice)
+                    and node.slice.lower is None
+                    and node.slice.upper is None):
+                problems.append(self._finding(
+                    fn, rel, node.lineno,
+                    f"{_terminal_name(node.value)}[:] slices the whole "
+                    "store — a full copy in subscript spelling"))
+
+    def _finding(self, fn, rel, line, what):
+        return Finding(
+            check=self.id, path=rel, line=line,
+            message=(f"'{fn.name}' is on the paging path "
+                     f"(_PAGED_READERS) but {what} — the demand-paged "
+                     "backend must never hold more than one block "
+                     "beyond the cache budget"),
+            hint="read bounded, bucket-aligned block slices instead, or "
+                 "annotate '# al-lint: paging-ok <reason>'")

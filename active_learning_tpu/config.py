@@ -221,6 +221,27 @@ class TrainConfig:
     # Scores, train batches, and k-center picks are bit-identical across
     # layouts (tests/test_pool_sharding.py) — throughput/HBM only.
     pool_sharding: str = "auto"
+    # Pool storage backend (the disk tier, DESIGN.md §16):
+    #   "auto"   — the in-memory pool unless it would cross the
+    #              host-RAM watermark (pool_disk_watermark_frac of
+    #              physical RAM), where the run takes the disk tier;
+    #   "memory" — the classic whole-pool host array;
+    #   "disk"   — demand-paged disk extents (data/diskpool.DiskPool):
+    #              rows live in one sparse extent file per host, gathers
+    #              page bucket-aligned blocks through a byte-bounded
+    #              host cache (pool_host_cache_bytes), and the labeled
+    #              hot set pins in HBM via the resident machinery.
+    # Picks, scores, and experiment_state are bit-identical across
+    # backends at the same seeds (tests/test_disk_pool.py) — this knob
+    # trades host RAM for paged-read bandwidth only.
+    pool_backend: str = "auto"
+    # Rows per paged block (snapped onto the pool.bucket_size ladder).
+    pool_page_rows: int = 2048
+    # Host block-cache budget for the warm tier, in bytes.
+    pool_host_cache_bytes: int = 1 << 30
+    # "auto" backend watermark: take the disk tier when the pool exceeds
+    # this fraction of physical host RAM.
+    pool_disk_watermark_frac: float = 0.5
     # Keep in-memory datasets resident on device (replicated) for the
     # whole experiment — ONE shared upload serves every round's
     # acquisition scoring AND the per-epoch validation/test evaluation
@@ -535,6 +556,13 @@ class ExperimentConfig:
     # arg pool (TrainConfig.feed_workers -> loader_tr.num_workers, the
     # reference's DataLoader num_workers row).
     feed_workers: Optional[int] = None
+
+    # Pool storage backend override ("auto"/"memory"/"disk"): None
+    # defers to the arg pool's TrainConfig.pool_backend, whose default
+    # auto keeps the in-memory pool until it would cross the host-RAM
+    # watermark, then takes the demand-paged disk tier (DESIGN.md §16).
+    # Bit-identical picks/scores/experiment_state across backends.
+    pool_backend: Optional[str] = None
 
     # Pipelined AL round (experiment/pipeline.py, DESIGN.md §8):
     # "speculative" overlaps the next query's pool-scoring pass with the

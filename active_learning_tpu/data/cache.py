@@ -48,6 +48,25 @@ from .core import Dataset
 from ..utils.logging import get_logger
 
 
+def _msync_range(arr: np.ndarray, lo_byte: int, hi_byte: int) -> bool:
+    """msync only the pages covering bytes [lo_byte, hi_byte) of a
+    memmap-backed array; returns False when the mmap backing cannot be
+    found (the caller falls back to a full flush).  numpy's
+    ``memmap.flush`` has no range form, so a per-batch whole-mapping
+    flush would sweep the entire multi-GB mapping from every writer."""
+    mm = arr
+    while mm is not None and not isinstance(mm, mmap.mmap):
+        mm = getattr(mm, "base", None)
+    if mm is None:
+        return False
+    gran = mmap.ALLOCATIONGRANULARITY
+    start = lo_byte // gran * gran
+    end = min(len(mm), -(-hi_byte // gran) * gran)
+    if end > start:
+        mm.flush(start, end - start)
+    return True
+
+
 class CachedEvalRows:
     """Wrap a dataset whose active view is deterministic; same gather
     contract, rows served from memory after first decode.
@@ -235,19 +254,11 @@ class DecodedPoolCache:
     def _flush_row_range(self, lo: int, hi: int) -> None:
         """msync only the pages covering rows [lo, hi): the populating
         pass writes contiguous batches, and a whole-mapping flush per
-        batch (numpy's memmap.flush has no range form) would sweep the
-        entire multi-GB mapping from every pipeline thread."""
-        mm = self._rows
-        while mm is not None and not isinstance(mm, mmap.mmap):
-            mm = getattr(mm, "base", None)
-        if mm is None:  # unexpected backing; fall back to the full msync
-            self._rows.flush()
-            return
+        batch would sweep the entire multi-GB mapping from every
+        pipeline thread (see ``_msync_range``)."""
         row_bytes = int(self._rows.strides[0])
-        gran = mmap.ALLOCATIONGRANULARITY
-        start = lo * row_bytes // gran * gran
-        end = min(len(mm), -(-(hi * row_bytes) // gran) * gran)
-        mm.flush(start, end - start)
+        if not _msync_range(self._rows, lo * row_bytes, hi * row_bytes):
+            self._rows.flush()  # unexpected backing; full msync
 
     def flush(self) -> None:
         self._rows.flush()
@@ -268,8 +279,8 @@ class GrowableRowStore:
     bucket boundary, never once per append (pinned in
     tests/test_compile_reuse.py).
 
-    Durability model: this file is DERIVED state.  The streaming
-    subsystem's source of truth is the fsync'd ingest WAL
+    Durability model: this file is DERIVED state by default.  The
+    streaming subsystem's source of truth is the fsync'd ingest WAL
     (stream/wal.py); the store is rebuilt from base data + WAL replay at
     every service start, so the store itself needs no write atomicity —
     creation is still tmp+rename (a half-created file never masquerades
@@ -278,10 +289,21 @@ class GrowableRowStore:
     pages appear).  ``rows`` is re-mapped only when capacity grows, so
     ``id(store.rows)`` is stable within a capacity epoch — exactly the
     identity the resident cache keys on.
+
+    ``reuse=True`` opts a caller into keeping an existing file instead:
+    the WAL-compaction path (stream/store.py) promotes the store to a
+    sealed disk extent whose prefix IS durable truth (rows a pruned WAL
+    segment can no longer rebuild).  The file is kept only when its
+    size is a whole number of rows covering the requested capacity —
+    any such size was produced by this class's own bucketed ftruncates,
+    so the capacity stays on the bucket ladder; anything else falls back
+    to the fresh-create path (``reused`` tells the caller which
+    happened, i.e. whether the prefix contents can be trusted).
     """
 
     def __init__(self, path: str, row_shape, dtype=np.uint8,
-                 capacity: int = 0, extent_floor: int = 256):
+                 capacity: int = 0, extent_floor: int = 256,
+                 reuse: bool = False):
         from ..pool import bucket_size
 
         self._bucket = lambda n: bucket_size(max(int(n), 1),
@@ -293,12 +315,25 @@ class GrowableRowStore:
                               or 1) * self.dtype.itemsize
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self.capacity = self._bucket(capacity)
-        # Fresh every construction: the store is derived (see docstring),
-        # and reusing a stale file would let a crashed run's rows shadow
-        # the WAL replay about to rebuild them.
-        with open(path + ".tmp", "wb") as fh:
-            fh.truncate(self.capacity * self._row_bytes)
-        os.replace(path + ".tmp", path)
+        # Written-interval tracking: flush() syncs only the dirty byte
+        # range (satellite of the §16 disk tier — the whole-file
+        # memmap.flush here used to sweep the full multi-GB mapping on
+        # every seal).
+        self._dirty: Optional[tuple] = None
+        self.reused = False
+        if reuse and os.path.exists(path):
+            size = os.path.getsize(path)
+            if (size >= self.capacity * self._row_bytes
+                    and size % self._row_bytes == 0):
+                self.capacity = size // self._row_bytes
+                self.reused = True
+        if not self.reused:
+            # Fresh every construction: the store is derived (see
+            # docstring), and reusing a stale file would let a crashed
+            # run's rows shadow the WAL replay about to rebuild them.
+            with open(path + ".tmp", "wb") as fh:
+                fh.truncate(self.capacity * self._row_bytes)
+            os.replace(path + ".tmp", path)
         self.rows = self._map()
 
     def _map(self) -> np.ndarray:
@@ -317,8 +352,29 @@ class GrowableRowStore:
         self.rows = self._map()
         return True
 
+    def note_written(self, lo: int, hi: int) -> None:
+        """Record rows [lo, hi) as written since the last flush; the
+        next ``flush`` syncs only the union of noted intervals."""
+        if hi <= lo:
+            return
+        if self._dirty is None:
+            self._dirty = (int(lo), int(hi))
+        else:
+            self._dirty = (min(self._dirty[0], int(lo)),
+                           max(self._dirty[1], int(hi)))
+
     def flush(self) -> None:
-        self.rows.flush()
+        """Sync the written row range to disk — a no-op when nothing
+        was written since the last flush, and never a whole-file sweep
+        for a small append (the data/cache.py flush-granularity fix)."""
+        if self._dirty is None:
+            return
+        lo, hi = self._dirty
+        hi = min(hi, self.capacity)
+        if hi > lo and not _msync_range(self.rows, lo * self._row_bytes,
+                                        hi * self._row_bytes):
+            self.rows.flush()  # unexpected backing; full msync
+        self._dirty = None
 
 
 def device_prefetch(batches, put, depth: int = 2):

@@ -1,0 +1,377 @@
+"""The disk tier of the pool storage hierarchy (DESIGN.md §16):
+disk extents -> bounded host block cache -> HBM residency.
+
+Every pool before this tier had to materialize in host RAM before
+sharding (``ArrayDataset.images``), capping the system at RAM-per-host
+rows.  ``DiskPool`` is the demand-paged backend behind
+``--pool_backend disk``: rows live in one sparse extent file on disk
+(written once, block by block, through the same bucketed-extent
+machinery as ``GrowableRowStore``), and gathers page **bucket-aligned
+row blocks** into a byte-bounded LRU host cache.  The hot tier above —
+the labeled rows the trainer scans every epoch — is pinned in HBM by
+the resident machinery (``parallel/resident.pin_hot``), counted by
+``pinned_bytes`` and demotable by ``enforce_budget`` like any pinned
+pool entry.
+
+Bit-identity: a ``DiskPool`` serves exactly the bytes of the array it
+spilled, so every consumer that reads through the ``Dataset`` contract
+(``gather`` + ``targets``) — the host scoring stream, the host train
+feeds, eval — produces results BIT-identical to the in-memory backend
+(pinned e2e in tests/test_disk_pool.py for Margin and Coreset).  The
+``images`` property deliberately raises AttributeError: every residency
+and feed gate in the codebase reads ``getattr(ds, "images", None)``, so
+a paged pool cleanly routes ALL whole-array consumers to the streaming
+paths (the same contract as a partially-populated DecodedPoolCache).
+Paging overlaps device compute for free: gathers run on the
+``device_prefetch`` / ``iterate_batches`` feeder threads, so a block
+read for batch n+1 hides behind batch n's dispatch.
+
+Honesty rules (statically enforced by al_lint check 17
+``disk-pool-paging`` over the ``_PAGED_READERS`` registry below): no
+paging-path function may materialize the whole store on one host — no
+``np.asarray(mm)``, no full ``mm[:]`` slice, no ``mm.copy()``.  Reads
+are bounded block slices; the spy counters (``max_read_rows``,
+``peak_cache_bytes``) let tests prove it dynamically too.
+
+Multi-host meshes: pass ``local_rows`` (``mesh.process_pool_rows``) and
+each process spills + reads ONLY its own contiguous row range — the
+same per-process slicing ``shard_rows`` uploads through — so the full
+pool never lands on any one host even transiently.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import faults
+from ..utils.logging import get_logger
+from .core import Dataset, ViewSpec
+
+# The closed registry of paging-path functions (al_lint check 17
+# ``disk-pool-paging``): these are the ONLY functions that touch the
+# disk extent, and none of them may materialize the whole store.
+_PAGED_READERS = ("gather", "_load_block", "spill_rows")
+
+# One retry policy for block reads off the disk tier (DESIGN.md §10):
+# OSError / injected faults are transient (NFS hiccup, racing page-out),
+# anything else is a programming error and re-raises immediately.
+_PAGE_RETRY = faults.RetryPolicy(site="page_read",
+                                 classify=faults.classify_exception,
+                                 max_attempts=3)
+
+# Bounded reservoir of per-block stall samples for the round percentiles
+# — big enough for every block of a round at ImageNet scale, small
+# enough to never matter.
+_STALL_SAMPLES_MAX = 8192
+
+
+def page_rows_for(requested: int, extent_floor: int = 64) -> int:
+    """Snap a requested block size onto the shared extent ladder
+    (``pool.bucket_size``) so paged blocks are bucket-aligned — the
+    same enumerable ladder the resident uploads and the growable store
+    extents live on."""
+    from ..pool import bucket_size
+    return bucket_size(max(int(requested), 1), floor=int(extent_floor))
+
+
+def spill_rows(mm: np.ndarray, source, lo: int, hi: int,
+               block_rows: int) -> None:
+    """Write rows [lo, hi) of ``source`` (anything with ``gather``, or a
+    plain array) into the extent memmap ``mm``, one bounded block at a
+    time — the spill never holds more than ``block_rows`` rows beyond
+    the source itself, and never slices the whole store."""
+    images = source if isinstance(source, np.ndarray) else None
+    for b0 in range(int(lo), int(hi), int(block_rows)):
+        b1 = min(b0 + int(block_rows), int(hi))
+        if images is not None:
+            mm[b0:b1] = images[b0:b1]
+        else:
+            mm[b0:b1] = source.gather(np.arange(b0, b1, dtype=np.int64))
+    mm.flush()
+
+
+class _DiskPoolCore:
+    """The shared storage + cache object behind every ``DiskPool`` view
+    (the train/al pair shares ONE extent file, one block cache, one
+    stats ledger — exactly like ``ArrayDataset.with_view`` shares one
+    array).
+
+    Thread contract: gathers run concurrently from the pipeline's
+    worker threads and the device_prefetch feeder; all cache + stats
+    bookkeeping is under ``_lock``.
+    """
+
+    # Lock discipline (al_lint lock-discipline): the block cache, its
+    # LRU order, and every stat counter are mutated from all feeder
+    # threads — only under _lock.
+    _GUARDED_BY = {"_blocks": "_lock", "_lru": "_lock",
+                   "_cache_bytes": "_lock", "_stats": "_lock",
+                   "_stalls": "_lock"}
+
+    def __init__(self, path: str, n_rows: int, image_shape,
+                 dtype=np.uint8, page_rows: int = 2048,
+                 host_cache_bytes: int = 1 << 30,
+                 local_rows: Optional[slice] = None):
+        self.path = path
+        self.n_rows = int(n_rows)
+        self.image_shape = tuple(int(d) for d in image_shape)
+        self.dtype = np.dtype(dtype)
+        self.page_rows = page_rows_for(page_rows)
+        self.host_cache_bytes = int(host_cache_bytes)
+        self.row_bytes = int(np.prod(self.image_shape, dtype=np.int64)
+                             or 1) * self.dtype.itemsize
+        # The per-process row range (multi-host meshes): reads outside
+        # it raise — this process's disk extent only ever held its own
+        # rows.  None = single-process, everything local.
+        self.local_rows = local_rows
+        self._mm: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+        self._blocks: Dict[int, np.ndarray] = {}
+        self._lru = []  # block ids, least-recently-used first
+        self._cache_bytes = 0
+        self._stats = {"hits": 0, "misses": 0, "rows_paged_in": 0,
+                       "page_in_time_s": 0.0, "max_read_rows": 0,
+                       "peak_cache_bytes": 0}
+        self._stalls = []  # per-block read ms, drained per round
+
+    # -- construction ------------------------------------------------------
+
+    def create(self, source) -> None:
+        """Sparse-create the extent file (tmp+rename, the store idiom)
+        and spill this process's row range of ``source`` into it,
+        block by block.  After this the source array can be dropped —
+        the disk extent is the pool."""
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        with open(self.path + ".tmp", "wb") as fh:
+            fh.truncate(self.n_rows * self.row_bytes)
+        os.replace(self.path + ".tmp", self.path)
+        mm = np.memmap(self.path, dtype=self.dtype, mode="r+",
+                       shape=(self.n_rows, *self.image_shape))
+        lo, hi = self._local_span()
+        spill_rows(mm, source, lo, hi, self.page_rows)
+        del mm
+        # Read-only from here on: the paging tier never writes the pool.
+        self._mm = np.memmap(self.path, dtype=self.dtype, mode="r",
+                             shape=(self.n_rows, *self.image_shape))
+        get_logger().info(
+            f"Disk pool at {self.path}: {hi - lo}/{self.n_rows} rows "
+            f"spilled ({(hi - lo) * self.row_bytes / 1e9:.2f} GB on "
+            f"disk), page block {self.page_rows} rows, host cache "
+            f"budget {self.host_cache_bytes / 1e6:.0f} MB")
+
+    def _local_span(self) -> Tuple[int, int]:
+        if self.local_rows is None:
+            return 0, self.n_rows
+        return (int(self.local_rows.start or 0),
+                int(self.n_rows if self.local_rows.stop is None
+                    else self.local_rows.stop))
+
+    # -- the paging path ---------------------------------------------------
+
+    def gather(self, idxs: np.ndarray) -> np.ndarray:
+        """Rows for ``idxs``, paged through the block cache.  Exactly
+        the bytes the spilled array held at those indices."""
+        idxs = np.asarray(idxs, dtype=np.int64)
+        out = np.empty((len(idxs), *self.image_shape), dtype=self.dtype)
+        if len(idxs) == 0:
+            return out
+        lo, hi = self._local_span()
+        if int(idxs.min()) < lo or int(idxs.max()) >= hi:
+            raise IndexError(
+                f"disk pool gather outside this process's rows "
+                f"[{lo}, {hi}): [{int(idxs.min())}, {int(idxs.max())}] "
+                "— multi-host paged reads must stay process-local")
+        block_ids = idxs // self.page_rows
+        for b in np.unique(block_ids):
+            blk = self._block(int(b))
+            sel = block_ids == b
+            out[sel] = blk[idxs[sel] - int(b) * self.page_rows]
+        return out
+
+    def _block(self, b: int) -> np.ndarray:
+        """One cached block, paging it in (under the read RetryPolicy)
+        on miss and evicting LRU blocks past the host-cache budget."""
+        with self._lock:
+            blk = self._blocks.get(b)
+            if blk is not None:
+                self._stats["hits"] += 1
+                if self._lru and self._lru[-1] != b:
+                    self._lru.remove(b)
+                    self._lru.append(b)
+                return blk
+            self._stats["misses"] += 1
+        t0 = time.perf_counter()
+        blk = _PAGE_RETRY.call(self._load_block, b)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            if b not in self._blocks:
+                self._blocks[b] = blk
+                self._lru.append(b)
+                self._cache_bytes += blk.nbytes
+                while (self._cache_bytes > self.host_cache_bytes
+                        and len(self._lru) > 1):
+                    cold = self._lru.pop(0)
+                    self._cache_bytes -= self._blocks.pop(cold).nbytes
+            self._stats["rows_paged_in"] += blk.shape[0]
+            self._stats["page_in_time_s"] += dt_ms / 1e3
+            self._stats["max_read_rows"] = max(
+                self._stats["max_read_rows"], blk.shape[0])
+            self._stats["peak_cache_bytes"] = max(
+                self._stats["peak_cache_bytes"], self._cache_bytes)
+            if len(self._stalls) < _STALL_SAMPLES_MAX:
+                self._stalls.append(dt_ms)
+        return blk
+
+    def _load_block(self, b: int) -> np.ndarray:
+        """Read block ``b`` off the disk extent into fresh host memory.
+        Two bounded half-reads with the torn fault point between: a
+        fault mid-block surfaces BEFORE anything enters the cache — a
+        torn read can never serve rows (the page_read chaos contract)."""
+        faults.site("page_read")
+        lo = b * self.page_rows
+        hi = min(lo + self.page_rows, self._local_span()[1])
+        blk = np.empty((hi - lo, *self.image_shape), dtype=self.dtype)
+        mid = (lo + hi) // 2
+        blk[: mid - lo] = self._mm[lo:mid]
+        faults.site("page_read", point="torn")
+        blk[mid - lo:] = self._mm[mid:hi]
+        return blk
+
+    # -- telemetry ---------------------------------------------------------
+
+    def take_round_stats(self) -> Dict[str, Optional[float]]:
+        """Per-round paging gauges (satellite of §16): absolute disk
+        rows, the round's cache hit fraction and page-in bandwidth, and
+        stall percentiles — counters and samples reset on read so each
+        round reports its own window."""
+        with self._lock:
+            s = dict(self._stats)
+            stalls = self._stalls
+            self._stalls = []
+            for k in ("hits", "misses", "rows_paged_in"):
+                self._stats[k] = 0
+            self._stats["page_in_time_s"] = 0.0
+        total = s["hits"] + s["misses"]
+        lo, hi = self._local_span()
+        out: Dict[str, Optional[float]] = {
+            "pool_disk_rows": float(hi - lo),
+            "pool_cache_hit_frac": (s["hits"] / total) if total else None,
+            "page_in_rows_per_sec": (
+                s["rows_paged_in"] / s["page_in_time_s"]
+                if s["page_in_time_s"] > 0 else None),
+            "page_in_stall_ms_p50": (
+                float(np.percentile(stalls, 50)) if stalls else None),
+            "page_in_stall_ms_p99": (
+                float(np.percentile(stalls, 99)) if stalls else None),
+        }
+        return out
+
+    def spy_counters(self) -> Dict[str, int]:
+        """Cumulative honesty counters for the no-full-materialization
+        spy test: the largest single read and the cache's peak bytes —
+        both must stay far below the pool."""
+        with self._lock:
+            return {"max_read_rows": self._stats["max_read_rows"],
+                    "peak_cache_bytes": self._stats["peak_cache_bytes"]}
+
+
+class DiskPool(Dataset):
+    """A ``Dataset`` view over one ``_DiskPoolCore`` — the disk-backed
+    twin of ``ArrayDataset``; ``with_view`` shares the core exactly like
+    ``ArrayDataset.with_view`` shares the array.  Targets stay in RAM
+    (int64 [N] — a few MB at 100M rows) so label bookkeeping, class
+    counts, and the pool-state machinery never touch disk."""
+
+    # Feed/residency gates read this to admit paged pools to the
+    # epoch-scan path (trainer.resolve_train_feed).
+    paged_backend = True
+
+    def __init__(self, core: _DiskPoolCore, targets: np.ndarray,
+                 num_classes: int, view: ViewSpec):
+        self._core = core
+        self.targets = np.asarray(targets, dtype=np.int64)
+        self.num_classes = int(num_classes)
+        self.view = view
+        self.image_shape = core.image_shape
+
+    def __len__(self) -> int:
+        return self._core.n_rows
+
+    @property
+    def images(self):
+        """Deliberately absent: the whole-pool array never exists on
+        one host.  Raising AttributeError (not returning the memmap!)
+        routes every ``getattr(ds, "images", None)`` residency/feed
+        gate to the streaming paths — the DecodedPoolCache contract."""
+        raise AttributeError(
+            "a DiskPool has no whole-pool images array; read through "
+            "gather() (the paged path)")
+
+    def gather(self, idxs: np.ndarray) -> np.ndarray:
+        return self._core.gather(idxs)
+
+    def with_view(self, view: ViewSpec) -> "DiskPool":
+        return DiskPool(self._core, self.targets, self.num_classes, view)
+
+    # Telemetry pass-throughs (the driver reads them off the al_set).
+    def take_round_stats(self) -> Dict[str, Optional[float]]:
+        return self._core.take_round_stats()
+
+    def spy_counters(self) -> Dict[str, int]:
+        return self._core.spy_counters()
+
+
+def host_ram_bytes() -> int:
+    """Physical host RAM (0 when the platform cannot say — callers then
+    never auto-select the disk tier)."""
+    try:
+        return (os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE"))
+    except (ValueError, OSError, AttributeError):
+        return 0
+
+
+def resolve_pool_backend(backend: str, pool_bytes: int,
+                         watermark_frac: float = 0.5) -> str:
+    """The ONE rule for which pool backend a run gets: explicit
+    ``memory``/``disk`` win; ``auto`` takes the disk tier only when the
+    pool would cross the host-RAM watermark (a pool comfortably in RAM
+    pays nothing for the paging layer it doesn't need)."""
+    if backend not in ("auto", "memory", "disk"):
+        raise ValueError(f"pool_backend={backend!r} is not one of "
+                         "auto/memory/disk")
+    if backend != "auto":
+        return backend
+    ram = host_ram_bytes()
+    if ram > 0 and pool_bytes > ram * float(watermark_frac):
+        return "disk"
+    return "memory"
+
+
+def wrap_pool(train_set, al_set, directory: str, page_rows: int = 2048,
+              host_cache_bytes: int = 1 << 30,
+              local_rows: Optional[slice] = None
+              ) -> Tuple[DiskPool, DiskPool]:
+    """Spill the (shared-storage) train/al dataset pair onto the disk
+    tier and return two ``DiskPool`` views over ONE core — after this
+    the in-memory images array has no live reference in the experiment
+    stack and the pool pages from disk for the rest of the run."""
+    images = getattr(train_set, "images", None)
+    if not isinstance(images, np.ndarray):
+        raise ValueError("pool_backend=disk needs an in-memory or "
+                         "memmap source pool to spill")
+    core = _DiskPoolCore(
+        os.path.join(directory, "pool_rows.u8"), len(train_set),
+        train_set.image_shape, dtype=images.dtype, page_rows=page_rows,
+        host_cache_bytes=host_cache_bytes, local_rows=local_rows)
+    core.create(images)
+    train_dp = DiskPool(core, train_set.targets, train_set.num_classes,
+                        train_set.view)
+    al_dp = DiskPool(core, al_set.targets, al_set.num_classes,
+                     al_set.view)
+    return train_dp, al_dp

@@ -162,6 +162,17 @@ def get_parser() -> argparse.ArgumentParser:
                         "row on any single-process multi-device mesh.  "
                         "Scores, batches, and k-center picks are "
                         "bit-identical across layouts")
+    p.add_argument("--pool_backend", type=str, default=None,
+                   choices=["auto", "memory", "disk"],
+                   help="pool storage backend (DESIGN.md §16): memory "
+                        "holds the whole pool in host RAM; disk pages "
+                        "bucket-aligned row blocks from a per-host "
+                        "extent file through a bounded host cache, so "
+                        "pools bigger than any host's RAM run on the "
+                        "same hardware.  auto (the default) takes the "
+                        "disk tier only past a host-RAM watermark.  "
+                        "Picks and experiment state are bit-identical "
+                        "across backends")
     p.add_argument("--train_feed", type=str, default=None,
                    choices=["auto", "resident", "host"],
                    help="train-batch feed: auto picks the top of the "
@@ -301,6 +312,7 @@ def args_to_config(args: argparse.Namespace) -> ExperimentConfig:
         train_feed=args.train_feed,
         pool_sharding=args.pool_sharding,
         feed_workers=args.feed_workers,
+        pool_backend=args.pool_backend,
         fused_optimizer=args.fused_optimizer,
         optim_state_dtype=args.optim_state_dtype,
         grad_allreduce=args.grad_allreduce,

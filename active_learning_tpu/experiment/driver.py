@@ -305,6 +305,12 @@ def build_experiment(
     if cfg.feed_workers is not None:
         train_cfg = dataclasses.replace(train_cfg,
                                         feed_workers=cfg.feed_workers)
+    if cfg.pool_backend is not None:
+        # --pool_backend beats the arg pool: which storage tier holds the
+        # pool is a host-RAM deployment choice, and the disk backend is
+        # bit-identical to memory by contract (DESIGN.md §16).
+        train_cfg = dataclasses.replace(train_cfg,
+                                        pool_backend=cfg.pool_backend)
     if cfg.fused_optimizer is not None:
         # --fused_optimizer beats the arg pool: bit-identical to optax
         # at f32 state, so this is a throughput/HBM deployment choice.
@@ -371,6 +377,43 @@ def build_experiment(
     trainer = Trainer(model, train_cfg, mesh, num_classes)
     trainer.grad_allreduce_degraded = grad_allreduce_degraded
 
+    # The disk tier (data/diskpool.py, DESIGN.md §16): pools bigger than
+    # any host's RAM spill to demand-paged disk extents — auto-engaged
+    # above the host-RAM watermark, forced with --pool_backend disk.
+    # Only fully-decoded in-RAM pools are wrapped: DecodedPoolCache and
+    # the stream service's StreamDataset are ALREADY disk/memmap-backed
+    # (their ``images`` is an np.memmap — an ndarray subclass — so the
+    # isinstance gate below must exclude it), and imperative-view
+    # datasets never expose a whole-pool array to spill in the first
+    # place.  On a multi-process mesh each host spills ONLY its own
+    # mesh.shard_rows row range (process_pool_rows) — the full array
+    # never lands on any one host's disk tier.
+    from ..data import diskpool as diskpool_lib
+    pool_images = getattr(al_set, "images", None)
+    if (isinstance(pool_images, np.ndarray)
+            and not isinstance(pool_images, np.memmap)):
+        pool_bytes = len(al_set) * int(np.prod(al_set.image_shape))
+        backend = diskpool_lib.resolve_pool_backend(
+            getattr(train_cfg, "pool_backend", "auto") or "auto",
+            pool_bytes,
+            getattr(train_cfg, "pool_disk_watermark_frac", 0.5))
+        if backend == "disk":
+            local_rows = (mesh_lib.process_pool_rows(mesh, len(al_set))
+                          if mesh_lib.is_multiprocess(mesh) else None)
+            train_set, al_set = diskpool_lib.wrap_pool(
+                train_set, al_set,
+                os.path.join(cfg.log_dir, "disk_pool"),
+                page_rows=train_cfg.pool_page_rows,
+                host_cache_bytes=train_cfg.pool_host_cache_bytes,
+                local_rows=local_rows)
+            get_logger().info(
+                f"pool_backend=disk: {pool_bytes / 1e9:.2f} GB pool "
+                f"demand-paged from {cfg.log_dir}/disk_pool "
+                f"(page_rows={train_cfg.pool_page_rows}, host cache "
+                f"{train_cfg.pool_host_cache_bytes / 1e9:.2f} GB"
+                + (f", local rows {local_rows.start}:{local_rows.stop}"
+                   if local_rows is not None else "") + ")")
+
     targets = train_set.targets[: len(train_set)]
     init_pool_size = cfg.resolved_init_pool_size()
     if cfg.debug_mode:
@@ -435,11 +478,22 @@ STREAM_GAUGES = (
     "ingest_ack_ms_p99",
 )
 
+# The disk tier's paging gauges (data/diskpool.py, DESIGN.md §16):
+# rows resident on disk, the host block cache's hit fraction, paging
+# throughput, and the gather-observed page-in stall percentiles.
+# Emitted only on rounds where the pool runs on the disk backend — the
+# memory backend pops them from the scrape (None drops, the same
+# honesty rule as the diagnostics gauges).
+PAGING_GAUGES = (
+    "pool_disk_rows", "pool_cache_hit_frac", "page_in_rows_per_sec",
+    "page_in_stall_ms_p50", "page_in_stall_ms_p99",
+)
+
 PER_ROUND_GAUGES = (
     "rd_round_time", "overlap_frac", "round_vs_max_phase",
     "rd_spec_score_time", "jit_cache_miss_delta", "fault_retries_total",
     "degrade_events", "hbm_peak_gb",
-) + DIAGNOSTICS_GAUGES + STREAM_GAUGES
+) + DIAGNOSTICS_GAUGES + STREAM_GAUGES + PAGING_GAUGES
 
 
 def _emit_round_gauges(telemetry, sink: MetricsSink, rd: int,
@@ -530,6 +584,19 @@ def _emit_round_telemetry(telemetry, sink: MetricsSink, rd: int,
         "degrade_events": ladder.events if ladder is not None else 0,
         "hbm_peak_gb": hbm,
     })
+    # The disk tier's per-round paging accounting (PAGING_GAUGES):
+    # take_round_stats drains and resets the counters, so each round's
+    # numbers are that round's alone.  On the memory backend the
+    # dataset has no disk tier and the gauges retract from the scrape
+    # (None values drop, same as stale diagnostics).
+    take_stats = getattr(strategy.al_set, "take_round_stats", None)
+    paging = take_stats() if callable(take_stats) else {}
+    _emit_round_gauges(telemetry, sink, rd,
+                       {k: paging.get(k) for k in PAGING_GAUGES})
+    stale_paging = {k: None for k in PAGING_GAUGES
+                    if paging.get(k) is None}
+    if stale_paging:
+        telemetry.set_gauges(**stale_paging)
     # Feed-boundedness gauges from the round's fit (trainer.last_feed):
     # a host-bound warm round reads off the Prometheus scrape / `status`
     # without a profiler.  feed_source is non-numeric, so it rides the

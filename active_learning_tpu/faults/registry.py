@@ -81,9 +81,14 @@ from typing import Any, Dict, Optional, Tuple
 #                 here must crash the service BEFORE any round consumes
 #                 a half-applied pool; the WAL replay on restart loses
 #                 no accepted row)
+#   page_read     data/diskpool._DiskPoolCore._load_block — one
+#                 bucket-aligned block read off the disk tier (torn
+#                 point between the block's two half-reads: a fault
+#                 there must never leave a partial block in the host
+#                 cache; the gather's RetryPolicy re-reads the block)
 SITES = ("h2d_upload", "ckpt_write", "spec_scorer", "feed_worker",
          "shard_upload", "dispatch", "grad_probe", "wal_write",
-         "stream_drain")
+         "stream_drain", "page_read")
 
 ACTIONS = ("raise", "oom", "die", "delay", "torn")
 

@@ -195,6 +195,20 @@ def process_local_rows(mesh: Mesh, batch_size: int) -> slice:
     return slice(lo, hi)
 
 
+def process_pool_rows(mesh: Mesh, n_rows: int) -> slice:
+    """The contiguous range of REAL pool rows [0, n_rows) owned by this
+    process under the row-sharded layout — ``process_local_rows`` over
+    the padded row count (``shard_rows`` pads to divide the mesh
+    evenly), clamped back to the real rows.  The disk-pool backend
+    (data/diskpool.py) reads only this range per host, the same
+    per-process slicing ``shard_rows`` uploads through, so a pool never
+    lands whole on any one host.  Single-process meshes own everything.
+    """
+    total = int(n_rows) + row_shard_pad(int(n_rows), mesh)
+    local = process_local_rows(mesh, total)
+    return slice(min(local.start, int(n_rows)), min(local.stop, int(n_rows)))
+
+
 def make_mesh(num_devices: int = -1,
               devices: Optional[Sequence[Any]] = None) -> Mesh:
     """1-D data-parallel mesh over the first ``num_devices`` devices

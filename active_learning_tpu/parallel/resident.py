@@ -545,6 +545,48 @@ def _dummy_like(images_dev, mesh, sharded: bool):
         out_shardings=sharding)()
 
 
+def pin_hot(cache: Optional[Dict], tag: str,
+            images_dev: Any, labels_dev: Any) -> bool:
+    """Register an ALREADY-UPLOADED hot row block under the shared
+    budget accounting — the disk tier's HBM leg (DESIGN.md §16): a
+    demand-paged pool never pins whole (its ``.images`` raises by
+    contract), but the trainer's hot labeled-subset copy is HBM like
+    any pinned pool and must show up in ``pinned_bytes`` so the ONE
+    per-chip budget figure covers all three tiers.  Keyed by ``tag``
+    (one slot per trainer): re-pinning the same tag replaces the entry
+    — the previous round's hot copy is released, never double-counted.
+    The entry stores no dataset (a paged pool has no id(images) to
+    key by); ``pinned_bytes`` and ``enforce_budget`` never inspect
+    keys, so the synthetic entry demotes LRU-first like any other —
+    a demotion only drops the cache's reference (the running fit holds
+    its own), so the budget squeeze lands at the NEXT fit's resolve."""
+    if cache is None:
+        return False
+    key = ("hot", tag)
+    with _CACHE_LOCK:
+        cache.setdefault("images", {})[key] = (None, images_dev,
+                                               labels_dev)
+        lru = cache.setdefault("lru", [])
+        if key in lru:
+            lru.remove(key)
+        lru.append(key)
+    return True
+
+
+def unpin_hot(cache: Optional[Dict], tag: str) -> bool:
+    """Drop a ``pin_hot`` entry (if present) — the disk tier's release
+    hook when a trainer's hot copy is abandoned rather than replaced."""
+    if not cache:
+        return False
+    key = ("hot", tag)
+    with _CACHE_LOCK:
+        entry = cache.get("images", {}).pop(key, None)
+        lru = cache.get("lru", [])
+        if key in lru:
+            lru.remove(key)
+    return entry is not None
+
+
 def release(cache: Optional[Dict], dataset: Any) -> bool:
     """Drop ``dataset``'s pinned entry (if any) so the NEXT access
     re-uploads — the streaming subsystem's invalidation hook: an ingest

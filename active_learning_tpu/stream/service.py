@@ -64,6 +64,7 @@ from . import store as store_lib
 from .scheduler import TriggerPolicy
 from .server import StreamIngestServer
 from .wal import IngestWAL, iter_payloads, replay_wal
+from .wal import prune_sealed as wal_prune_sealed
 
 WAL_DIR = "ingest_wal"
 POOL_DIR = "stream_pool"
@@ -98,6 +99,7 @@ class StreamService:
         self.last_trigger: Dict = {"cause": None, "ts": None}
         self._cause_counts: Dict[str, int] = {}
         self._probed_rows = 0
+        self._applied_seq = 0
         self._loop_thread: Optional[threading.Thread] = None
         self._aio: Optional[asyncio.AbstractEventLoop] = None
 
@@ -258,7 +260,19 @@ class StreamService:
             os.path.join(cfg.log_dir, POOL_DIR), base_train.image_shape,
             base_al.num_classes, base_images=images[:n_base],
             base_targets=base_train.targets[:n_base],
-            extent_floor=scfg.extent_floor)
+            extent_floor=scfg.extent_floor, reuse=True)
+        if self.store.applied_seq > 0 and not resuming:
+            # Compaction trades replay-from-scratch for a bounded WAL:
+            # the pruned prefix's pool bookkeeping (which rows carried
+            # oracle labels, which were absorbed) lives only in the
+            # saved experiment state.  A fresh run over a compacted
+            # log_dir cannot rebuild that timeline — refuse rather than
+            # silently diverge from what a full replay would produce.
+            raise ValueError(
+                f"stream: {cfg.log_dir!r} holds a compacted pool store "
+                f"(WAL prefix through seq {self.store.applied_seq} "
+                "absorbed into sealed extents); pass --resume_training "
+                "to continue that experiment, or use a fresh log_dir")
         # Build-time datasets span the BASE rows only: the eval split
         # and init pool are seeded over data round 0 of ANY timeline
         # can see, so every ingest schedule shares them.
@@ -274,12 +288,34 @@ class StreamService:
             self.logger.info(
                 f"stream: WAL replay dropped {dropped} torn un-acked "
                 "tail record")
-        # The appender reuses this replay (one full-WAL read per start).
+        # Compaction consistency: the store's manifest names the WAL
+        # prefix its sealed extents absorb.  Surviving records may
+        # overlap that prefix (a prune interrupted mid-delete) — those
+        # are skipped below — but a replay that STARTS past
+        # applied_seq + 1 means a sealed segment the manifest never
+        # absorbed is gone, and no amount of replay can paper over it.
+        if records and records[0]["seq"] > self.store.applied_seq + 1:
+            raise ValueError(
+                f"stream: WAL starts at seq {records[0]['seq']} but the "
+                f"pool store only absorbs through seq "
+                f"{self.store.applied_seq} — a sealed WAL segment is "
+                "missing")
+        # The appender reuses this replay (one full-WAL read per start);
+        # base_seq continues the chain when compaction pruned every
+        # segment.
         self.wal = IngestWAL(wal_dir, rotate_bytes=scfg.wal_rotate_bytes,
-                             replayed=records)
+                             replayed=records,
+                             base_seq=self.store.applied_seq)
         self.queue = ingest_lib.PendingQueue(scfg.max_backlog_rows)
+        self._applied_seq = self.store.applied_seq
         replayed_rows = 0
+        skipped = 0
         for rec in iter_payloads(records):
+            if rec["seq"] <= self.store.applied_seq:
+                # Already sealed into the store's extents (and counted
+                # in its n_rows) — re-queueing would double-apply.
+                skipped += 1
+                continue
             if rec.get("kind") == "pool":
                 n = int(rec["shape"][0])
                 self.queue.push(rec, n_rows=n, n_labels=0)
@@ -290,7 +326,9 @@ class StreamService:
         if records:
             self.logger.info(
                 f"stream: replayed {len(records)} WAL records "
-                f"({replayed_rows} pool rows) into the pending queue")
+                f"({replayed_rows} pool rows) into the pending queue"
+                + (f"; {skipped} compacted record(s) skipped"
+                   if skipped else ""))
 
         strategy = build_experiment(
             cfg, sink=self._sink,
@@ -302,15 +340,24 @@ class StreamService:
         # replayed pool row, with the eval split unlabelable — a label
         # the drain could never absorb must be a 400 BEFORE the WAL
         # write, or it would replay into the same failure forever.
-        self.ids = ingest_lib.IdSpace(n_base + replayed_rows,
+        # ``store.n_rows`` covers base + any compacted extents; only the
+        # still-pending replay rows ride on top.
+        self.ids = ingest_lib.IdSpace(self.store.n_rows + replayed_rows,
                                       unlabelable=strategy.pool.eval_idxs)
         self.drift = diag_lib.ServeScoreDrift(key="margin")
         if resuming:
             start_round = resume_lib.load_experiment(strategy, cfg)
             strategy.resume_next_fit = True
             # The restored pool may already span extents a previous
-            # segment drained; the datasets must present that capacity
-            # (the store itself refills at the first drain's replay).
+            # segment drained; the datasets must present that capacity.
+            # Un-compacted growth refills at the first drain's replay,
+            # but COMPACTED extents never re-enter the queue — the store
+            # reopened them directly, so the dataset snapshots must be
+            # retaken here or the restored pool would outsize its
+            # datasets.
+            if self.store.capacity > n_base:
+                self._al_sd.refresh()
+                self._train_sd.refresh()
         else:
             start_round = 0
             self._sink.log_parameters(config_to_dict(cfg))
@@ -529,6 +576,26 @@ class StreamService:
                 strategy.test()
             if mesh_lib.is_coordinator():
                 save_retry.call(resume_lib.save_experiment, strategy, cfg)
+                # The experiment state trained on this round's pool is
+                # durable — NOW the drained WAL prefix may compact into
+                # sealed extents and its segments go (DESIGN.md §16).
+                # Best-effort: a failed compaction costs replay work at
+                # the next start, never correctness (the WAL it would
+                # have pruned is still whole).
+                try:
+                    self.store.compact(self._applied_seq)
+                    pruned = wal_prune_sealed(
+                        os.path.join(cfg.log_dir, WAL_DIR),
+                        self.store.applied_seq)
+                    if pruned:
+                        self.logger.info(
+                            f"stream: compacted WAL through seq "
+                            f"{self.store.applied_seq}; pruned {pruned} "
+                            "sealed segment(s)")
+                except OSError:
+                    self.logger.warning(
+                        "stream: WAL compaction failed; will retry "
+                        "next round", exc_info=True)
             cfg.resume_training = True
             journal.write(round=rd, phase="round_end",
                           labeled=strategy.pool.num_labeled,
@@ -574,6 +641,12 @@ class StreamService:
                     oracle_ids.append(ids)
             else:
                 label_batches.append(self.store.apply_label_record(rec))
+        # The high-water mark of applied WAL records: what the round-end
+        # compaction may seal into the store's extents (and prune from
+        # the WAL) once the experiment state trained on them is durable.
+        self._applied_seq = max(
+            [self._applied_seq]
+            + [int(r["seq"]) for r in records if "seq" in r])
         trainer = strategy.trainer
         grew = self.store.capacity != pre_capacity
         if grew:

@@ -27,6 +27,7 @@ resume contract).
 from __future__ import annotations
 
 import base64
+import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -37,33 +38,103 @@ from ..data.core import Dataset, ViewSpec
 
 UNKNOWN_LABEL = -1
 
+# The compaction manifest (DESIGN.md §16): {applied_seq, n_rows, n_base,
+# capacity, image_shape, num_classes}, written tmp+fsync+rename AFTER
+# both row stores range-flushed — so a manifest on disk ALWAYS describes
+# extents whose bytes are durable.  Its applied_seq is the WAL prefix
+# the sealed extents absorb: replay skips records at or below it, and
+# wal.prune_sealed may delete segments wholly at or below it.
+MANIFEST_FILE = "compact.json"
+
+
+def read_manifest(directory: str) -> Optional[Dict[str, Any]]:
+    """The compaction manifest, or None when absent/unreadable (an
+    unreadable manifest reads as nothing-to-reuse — the store rebuilds
+    from base + WAL replay, same as the torn-checkpoint rule)."""
+    path = os.path.join(directory, MANIFEST_FILE)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            m = json.load(fh)
+        if not isinstance(m, dict):
+            return None
+        for k in ("applied_seq", "n_rows", "n_base", "capacity",
+                  "image_shape", "num_classes"):
+            if k not in m:
+                return None
+        return m
+    except (OSError, ValueError):
+        return None
+
 
 class PoolStore:
     def __init__(self, directory: str, image_shape: Tuple[int, int, int],
                  num_classes: int,
                  base_images: Optional[np.ndarray] = None,
                  base_targets: Optional[np.ndarray] = None,
-                 extent_floor: int = 256):
+                 extent_floor: int = 256, reuse: bool = False):
+        self.directory = directory
         self.image_shape = tuple(int(d) for d in image_shape)
         self.num_classes = int(num_classes)
         n0 = len(base_images) if base_images is not None else 0
+        # Sealed-extent reuse (``reuse``): a compaction manifest that
+        # matches this pool's identity re-opens the extents as they were
+        # sealed — no base copy, no replay of the absorbed WAL prefix.
+        # Any mismatch (different base length, row shape, classes, or a
+        # store file that does not cover the manifest's capacity) falls
+        # back to a FRESH build and deletes the stale manifest: a
+        # manifest describing extents we just truncated must never be
+        # believed by the next open.
+        manifest = read_manifest(directory) if reuse else None
+        if manifest is not None and (
+                tuple(manifest["image_shape"]) != self.image_shape
+                or int(manifest["num_classes"]) != self.num_classes
+                or int(manifest["n_base"]) != n0):
+            manifest = None
+        cap0 = int(manifest["capacity"]) if manifest is not None else n0
         self._rows = GrowableRowStore(
             os.path.join(directory, "pool_rows.u8"), self.image_shape,
-            dtype=np.uint8, capacity=n0, extent_floor=extent_floor)
+            dtype=np.uint8, capacity=cap0, extent_floor=extent_floor,
+            reuse=manifest is not None)
         self._targets = GrowableRowStore(
             os.path.join(directory, "pool_targets.i64"), (),
-            dtype=np.int64, capacity=n0, extent_floor=extent_floor)
+            dtype=np.int64, capacity=cap0, extent_floor=extent_floor,
+            reuse=manifest is not None)
+        if manifest is not None and not (self._rows.reused
+                                         and self._targets.reused):
+            # Half a reuse is corruption waiting to replay: rebuild both
+            # stores fresh and drop the manifest they no longer match.
+            self._rows = GrowableRowStore(
+                os.path.join(directory, "pool_rows.u8"),
+                self.image_shape, dtype=np.uint8, capacity=n0,
+                extent_floor=extent_floor)
+            self._targets = GrowableRowStore(
+                os.path.join(directory, "pool_targets.i64"), (),
+                dtype=np.int64, capacity=n0, extent_floor=extent_floor)
+            manifest = None
+        if manifest is None:
+            try:
+                os.remove(os.path.join(directory, MANIFEST_FILE))
+            except OSError:
+                pass
+        self.applied_seq = (int(manifest["applied_seq"])
+                            if manifest is not None else 0)
+        if manifest is not None:
+            self.n_rows = int(manifest["n_rows"])
+            self.n_base = int(manifest["n_base"])
+            return
         self.n_rows = 0
         self.n_base = 0
         if base_images is not None:
             assert base_images.dtype == np.uint8
             self._rows.rows[:n0] = base_images[:n0]
+            self._rows.note_written(0, n0)
             self._targets.rows[:n0] = np.asarray(base_targets,
                                                  dtype=np.int64)[:n0]
             self.n_rows = self.n_base = n0
         # Fresh capacity slots are zero-filled by the sparse create; the
         # targets of padding slots must read UNKNOWN, not class 0.
         self._targets.rows[self.n_rows:] = UNKNOWN_LABEL
+        self._targets.note_written(0, self._targets.capacity)
 
     @property
     def capacity(self) -> int:
@@ -82,10 +153,13 @@ class PoolStore:
         self._targets.ensure_capacity(start + n)
         if grew:
             self._targets.rows[start + n:] = UNKNOWN_LABEL
+            self._targets.note_written(start + n, self._targets.capacity)
         self._rows.rows[start:start + n] = rows
+        self._rows.note_written(start, start + n)
         self._targets.rows[start:start + n] = (
             np.asarray(labels, dtype=np.int64) if labels is not None
             else UNKNOWN_LABEL)
+        self._targets.note_written(start, start + n)
         self.n_rows = start + n
         return np.arange(start, start + n, dtype=np.int64)
 
@@ -101,6 +175,8 @@ class PoolStore:
             raise ValueError(
                 f"label record names rows outside [0, {self.n_rows})")
         self._targets.rows[ids] = labels
+        if ids.size:
+            self._targets.note_written(int(ids.min()), int(ids.max()) + 1)
         return ids, labels
 
     # -- dataset views ----------------------------------------------------
@@ -125,6 +201,38 @@ class PoolStore:
     def flush(self) -> None:
         self._rows.flush()
         self._targets.flush()
+
+    def compact(self, applied_seq: int) -> None:
+        """Seal the pool's current state into the disk extents: range-
+        flush both stores (msync of exactly the written regions — the
+        PR's flush-granularity rule), THEN atomically publish the
+        manifest naming the WAL prefix those bytes absorb.  Write order
+        is the correctness: a crash between flush and rename leaves the
+        OLD manifest, so replay re-applies the un-manifested records
+        idempotently (apply_* write the same bytes to the same rows —
+        ``n_rows`` comes from the manifest, not the file size).  Called
+        at round end AFTER save_experiment succeeds: the experiment
+        state and the pool prefix it was trained on go durable together,
+        which is what keeps WAL-replay resume bit-identical."""
+        applied_seq = int(applied_seq)
+        if applied_seq <= self.applied_seq:
+            return
+        self._rows.flush()
+        self._targets.flush()
+        manifest = {"applied_seq": applied_seq,
+                    "n_rows": int(self.n_rows),
+                    "n_base": int(self.n_base),
+                    "capacity": int(self.capacity),
+                    "image_shape": list(self.image_shape),
+                    "num_classes": int(self.num_classes)}
+        path = os.path.join(self.directory, MANIFEST_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        self.applied_seq = applied_seq
 
 
 class StreamDataset(Dataset):

@@ -77,7 +77,7 @@ class IngestWAL:
     reads on the service thread — all under ``_lock``."""
 
     def __init__(self, directory: str, rotate_bytes: int = 64 << 20,
-                 replayed=None):
+                 replayed=None, base_seq: int = 0):
         self.directory = directory
         self.rotate_bytes = int(rotate_bytes)
         os.makedirs(directory, exist_ok=True)
@@ -88,9 +88,13 @@ class IngestWAL:
         # ``replayed``: a caller that already ran replay_wal on this
         # directory (the service's startup) hands its records in so a
         # gigabyte WAL is read + crc'd once per start, not twice.
+        # ``base_seq``: the compacted prefix's last seq (PoolStore's
+        # manifest, DESIGN.md §16) — when compaction pruned EVERY
+        # segment, the chain must continue from the manifest, not
+        # restart at 1 (a reused seq would alias a compacted record).
         records = (replayed if replayed is not None
                    else replay_wal(directory)[0])
-        self._seq = records[-1]["seq"] if records else 0
+        self._seq = records[-1]["seq"] if records else int(base_seq)
         self._first_active_seq: Optional[int] = None
         # A kill mid-append leaves a torn (newline-less) tail; replay
         # already refused to serve it, and appending AFTER it would glue
@@ -214,12 +218,55 @@ def replay_wal(directory: str) -> Tuple[List[Dict[str, Any]], int]:
                     f"corrupt WAL record in {path} line {li + 1}: {e}")
             rec["_file"] = os.path.basename(path)
             records.append(rec)
+    # Contiguity is checked relative to the FIRST surviving record, not
+    # seq 1: compaction (stream/store.py) prunes whole sealed segments
+    # the manifest's extents absorb, so a pruned WAL legitimately starts
+    # past 1.  Whether the missing prefix is compacted-or-lost is the
+    # caller's check (the service validates records[0] against the
+    # manifest's applied_seq); a hole in the MIDDLE is always
+    # corruption.
+    first = records[0]["seq"] if records else 1
     for i, rec in enumerate(records):
-        if rec["seq"] != i + 1:
+        if rec["seq"] != first + i:
             raise ValueError(
-                f"WAL seq gap: expected {i + 1}, found {rec['seq']} — a "
-                "sealed segment is missing or reordered")
+                f"WAL seq gap: expected {first + i}, found {rec['seq']} "
+                "— a sealed segment is missing or reordered")
     return records, dropped
+
+
+def prune_sealed(directory: str, upto_seq: int) -> int:
+    """Delete SEALED segments whose every record is at or below
+    ``upto_seq`` — the compaction hook (stream/store.py writes the
+    manifest first; only then may the absorbed prefix go).  A segment's
+    coverage is read off its LAST parseable line (segments are
+    seq-ordered by construction); a segment that straddles the boundary
+    stays whole — replay skips its absorbed records by seq, losing
+    nothing.  The ACTIVE file is never touched: the appender owns it.
+    Returns the number of segments deleted; unreadable/undecodable
+    segments are left alone (deleting what we cannot prove absorbed
+    would turn a read hiccup into data loss)."""
+    if not os.path.isdir(directory):
+        return 0
+    deleted = 0
+    for path in sorted(glob.glob(os.path.join(directory, SEALED_GLOB))):
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+            lines = [ln for ln in raw.split(b"\n") if ln]
+            if not lines:
+                continue
+            last = json.loads(lines[-1].decode())
+            if not isinstance(last, dict) or "seq" not in last:
+                continue
+            if int(last["seq"]) <= int(upto_seq):
+                os.remove(path)
+                deleted += 1
+            else:
+                # Segments are seq-ordered; the first survivor ends it.
+                break
+        except (OSError, ValueError, UnicodeDecodeError):
+            continue
+    return deleted
 
 
 def iter_payloads(records: List[Dict[str, Any]]) -> Iterator[Dict[str, Any]]:

@@ -147,6 +147,8 @@ def summarize(log_dir: str, stale_after: Optional[float] = None,
         "overlap_frac", "round_vs_max_phase", "spec_hit_frac",
         "rd_score_drift_psi", "rd_score_drift_js", "rd_score_mean",
         "rd_pick_class_balance", "rd_pick_novelty", "rd_ece",
+        "pool_disk_rows", "pool_cache_hit_frac", "page_in_rows_per_sec",
+        "page_in_stall_ms_p50", "page_in_stall_ms_p99",
     ])
     state = ("no-heartbeat" if not heartbeats
              else "stale" if any_stale else "ok")
@@ -269,6 +271,22 @@ def render_text(summary: Dict[str, Any]) -> str:
         if any(name in m for name in drift_names):
             lines.append("  drift / acquisition:")
             for name in drift_names:
+                if name in m:
+                    e = m[name]
+                    step = (f" @step {e['step']}"
+                            if e.get("step") is not None else "")
+                    lines.append(f"    {name:>22} = {e['value']}{step}")
+        # The disk-tier tail (data/diskpool.py, DESIGN.md §16): present
+        # only when the run pages its pool from disk — spill volume,
+        # host-cache hit rate, and page-in stall percentiles, so a
+        # glance shows whether the paging tier is keeping up or the
+        # round is stalling on reads.
+        paging_names = ("pool_disk_rows", "pool_cache_hit_frac",
+                        "page_in_rows_per_sec", "page_in_stall_ms_p50",
+                        "page_in_stall_ms_p99")
+        if any(name in m for name in paging_names):
+            lines.append("  disk tier:")
+            for name in paging_names:
                 if name in m:
                     e = m[name]
                     step = (f" @step {e['step']}"

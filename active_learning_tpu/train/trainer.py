@@ -691,13 +691,22 @@ class Trainer:
                 "'host'")
         images = getattr(train_set, "images", None)
         in_mem = isinstance(images, np.ndarray)
+        # The disk tier (data/diskpool.py, DESIGN.md §16): a paged pool
+        # exposes no whole-pool array (``.images`` raises — the static
+        # no-materialization contract), but its ``gather`` pages the
+        # LABELED rows in bucket-aligned blocks, so the hot tier — the
+        # private labeled-subset HBM copy — still applies.  Excluded on
+        # multi-process meshes: the copy gathers GLOBAL labeled rows,
+        # and each host's disk tier holds only its own row range.
+        paged = bool(getattr(train_set, "paged_backend", False)) \
+            and not mesh_lib.is_multiprocess(self.mesh)
         hook_free = batch_hook is None
 
         prefetched = hook_free and (self._feed_workers() > 0
                                     or self.cfg.loader_tr.prefetch > 0)
         host = "host_prefetch" if prefetched else "host_serial"
 
-        scan_possible = hook_free and in_mem \
+        scan_possible = hook_free and (in_mem or paged) \
             and self.cfg.device_resident is not False
         resident_ok = scan_possible and resident_lib.eligible(
             train_set, self.resident_budget, cache=self.resident_pool,
@@ -725,11 +734,21 @@ class Trainer:
             if resident_ok:
                 return "resident"
             bs = self.padded_batch_size(self.cfg.loader_tr.batch_size)
+            # Backend-agnostic row bytes: a paged pool has no whole
+            # array to read shape/itemsize off (uint8 rows by the disk
+            # tier's storage contract).
+            row_bytes = (int(np.prod(images.shape[1:])) * images.itemsize
+                         if in_mem
+                         else int(np.prod(train_set.image_shape)))
             copy_bytes = (self.bucket_steps(num_batches(len(labeled_idxs),
                                                         bs)) * bs
-                          * int(np.prod(train_set.images.shape[1:]))
-                          * train_set.images.itemsize)
-            if train_set.images.nbytes <= 2 ** 31 and (
+                          * row_bytes)
+            # The legacy whole-array size guard applies to what actually
+            # materializes: the full pool on the in-memory backend, only
+            # the hot labeled copy on the paged one (the pool itself is
+            # deliberately bigger than any host's RAM there).
+            size_guard = (images.nbytes if in_mem else copy_bytes)
+            if size_guard <= 2 ** 31 and (
                     # Explicit device_resident=True keeps its legacy
                     # meaning (force the scan path regardless of the
                     # residency budget); under AUTO the private labeled
@@ -1123,6 +1142,15 @@ class Trainer:
             # little and cost a layout axis on the step bucketing).
             dr_images, dr_labels = self._device_resident_arrays(
                 train_set, labeled_idxs, bs)
+            if getattr(train_set, "paged_backend", False):
+                # The disk tier's HBM leg: the hot copy joins the shared
+                # budget accounting (pinned_bytes/enforce_budget) under
+                # one per-trainer slot — re-pinned each fit, so the
+                # previous round's copy is replaced, never accumulated.
+                from ..parallel import resident as resident_lib
+                resident_lib.pin_hot(self.resident_pool,
+                                     f"hot_rows@{id(self):x}",
+                                     dr_images, dr_labels)
         best_perf, best_epoch, es_count = 0.0, 0, 0
         best_variables = None  # device tree after an improvement this fit
         best_dirty = False  # True = best_variables newer than best_ckpt

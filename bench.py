@@ -136,6 +136,13 @@ PHASES = [
     # README's production number came from the alt probe — now it IS the
     # primary capture.
     ("resnet18_cifar_score", 30, 512, 420),
+    # The disk tier (DESIGN.md §16): the same 2-round experiment under
+    # the memory backend and the demand-paged disk backend with the
+    # pool pinned at 4x the residency budgets — asserts bit-identical
+    # picks/accuracy and records the paging tax (hit fraction, page-in
+    # rate, stall percentiles).  iters is the per-round epoch count;
+    # per-chip batch is unused (the production config decides).
+    ("disk_pool_feed", 2, 64, 900),
     # The selection hot loop (SURVEY hard part (a)): greedy k-center over
     # a 50k-row, 2048-dim pool — the reference's paper protocol subsets
     # the pool to 50k and picks 10k per round (gen_jobs.py:8-13).  iters
@@ -214,12 +221,25 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # and the pod-tier riders — ISSUE 15: the quantized wire form on both
 # train phases ('"grad_sync":"rs",' x2 ≈ 36 bytes; grad_wire_mb stays
 # in the evidence file) plus the ring-feed tag on both round phases and
-# the maxn probe ('"ring":true,' x3 ≈ 36 bytes)) without truncation;
-# staged truncation in _compact_line still guards the pathological
-# cases.  14 phases ride; 1950 leaves ~50 bytes of tail-window slop
-# (the tail carries nothing but this line and its newline), and the
-# all-failed degraded form stays under the 1750-byte tail-slop pin in
-# tests/test_bench_json.py.  Pinned by unit tests at both extremes.
+# the maxn probe ('"ring":true,' x3 ≈ 36 bytes) — and the disk-tier
+# phase — ISSUE 16: one more phase entry (~30 bytes) plus its riders,
+# worst case '"hit":0.NNN,"stall_ms":NN.NN,' ≈ 30 bytes; the finer
+# paging figures (page-in rate, p50, the memory-leg comparison) stay in
+# the evidence file) without truncation; staged truncation in
+# _compact_line still guards the pathological cases.  NOTE the
+# accounting above counts COMPACT spellings ('"ack_p99":NNN.NNN,' — no
+# spaces), which json.dumps only emits under explicit
+# separators=(",", ":"); the default ", "/": " separators spent one
+# unbudgeted tail byte per key and comma (~150 bytes across the rich
+# form) until ISSUE 16's 15th phase pushed the spaced form past the
+# bound and exposed the gap — _compact_line now dumps compact.  15
+# phases ride; the measured realistic-maximal rich form is ~1780 bytes
+# (pinned ≤ MAX_LINE_BYTES by test_compact_line_bounded_all_phases_full
+# with every phase's riders present), 1950 leaves ~50 bytes of
+# tail-window slop (the tail carries nothing but this line and its
+# newline), and the all-failed degraded form stays under the 1750-byte
+# tail-slop pin in tests/test_bench_json.py.  Pinned by unit tests at
+# both extremes.
 MAX_LINE_BYTES = 1950
 
 
@@ -1676,6 +1696,163 @@ def run_al_round_phase(config: str, epochs: int) -> dict:
     }
 
 
+def run_disk_pool_feed_phase(epochs: int) -> dict:
+    """The disk tier measured (DESIGN.md §16): the SAME 2-round AL
+    experiment through the production driver twice — once on the
+    in-memory pool backend, once on the demand-paged disk backend with
+    the pool held at >= 4x both residency budgets (HBM pin AND host
+    block cache) — asserting the backends pick the SAME rows and land
+    the SAME accuracy (the tier's bit-identity contract), and recording
+    what the paging actually cost: the disk leg's in-loop train rate,
+    its warm-round block-cache hit fraction, page-in throughput, and
+    the gather-observed stall percentiles, all from the driver's own
+    PAGING_GAUGES telemetry stream (bench never times the pager
+    itself).
+
+    The pool is the CIFAR protocol shape (synthetic, so the phase is
+    data-path-pure): 50k rows at 32px f32 = ~614 MB, budgets capped at
+    a quarter of that.  Absolute RAM is modest — the phase's subject is
+    the PAGING MACHINERY at a pinned pool:budget ratio, not exhausting
+    this host's DIMMs."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    from active_learning_tpu.config import ExperimentConfig
+    from active_learning_tpu.data.synthetic import get_data_synthetic
+    from active_learning_tpu.experiment.arg_pools import get_train_config
+    from active_learning_tpu.experiment.driver import run_experiment
+    from active_learning_tpu.utils.metrics import MetricsSink
+
+    class CaptureSink(MetricsSink):
+        def __init__(self):
+            self.metrics = []  # (name, value, step)
+
+        def log_parameters(self, params):
+            pass
+
+        def log_metrics(self, metrics, step=None):
+            for k, v in metrics.items():
+                self.metrics.append((k, float(v), step))
+
+        def log_asset(self, name, data):
+            pass
+
+    smoke = os.environ.get("AL_BENCH_ROUND_SMOKE") == "1"
+    if smoke:
+        pool_n, test_n, budget, page_rows = 2000, 500, 40, 256
+    else:
+        pool_n, test_n, budget, page_rows = 50000, 10000, 1000, 2048
+    pool_bytes = pool_n * 32 * 32 * 3 * 4  # f32 rows, CIFAR shape
+    # BOTH residency tiers capped at a quarter of the pool: the HBM pin
+    # (resident_scoring_bytes) and the host block cache — a disk leg
+    # that could cache the whole pool would measure the memory backend
+    # with extra steps.
+    budget_bytes = pool_bytes // 4
+    train_cfg = dataclasses.replace(
+        get_train_config("default", "cifar10"),
+        resident_scoring_bytes=budget_bytes,
+        pool_host_cache_bytes=budget_bytes,
+        pool_page_rows=page_rows)
+    device_kind = jax.devices()[0].device_kind
+    n_chips = len(jax.devices())
+    log(f"[disk_pool_feed] {n_chips}x {device_kind}, pool {pool_n} rows "
+        f"({pool_bytes / 1e6:.0f} MB) at 4.0x the "
+        f"{budget_bytes / 1e6:.0f} MB residency budget, budget {budget}, "
+        f"{epochs} epochs, 2 rounds per leg")
+
+    def leg(backend):
+        # Fresh data per leg from the SAME seed: bit-identity must hold
+        # over identical inputs, and the driver absorbs labels into the
+        # datasets it is handed.
+        data = get_data_synthetic(n_train=pool_n, n_test=test_n)
+        tmp = tempfile.mkdtemp(prefix=f"al_bench_diskfeed_{backend}_")
+        sink = CaptureSink()
+        cfg = ExperimentConfig(
+            dataset="cifar10", strategy="MarginSampler", rounds=2,
+            round_budget=budget, init_pool_size=0, model="SSLResNet18",
+            n_epoch=epochs, early_stop_patience=epochs,
+            enable_metrics=True, run_seed=17, pool_backend=backend,
+            log_dir=tmp, ckpt_path=tmp, exp_hash="bench")
+        t0 = time.perf_counter()
+        try:
+            strategy = run_experiment(cfg, sink=sink, data=data,
+                                      train_cfg=train_cfg)
+            return {
+                "backend": backend,
+                "labeled": np.array(strategy.pool.labeled, copy=True),
+                "acc": strategy.last_test_acc,
+                "sink": sink,
+                "al_set_kind": type(strategy.al_set).__name__,
+                "total_sec": time.perf_counter() - t0,
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    mem = leg("memory")
+    disk = leg("disk")
+
+    def gauge(run, name, rd):
+        return next((v for k, v, s in run["sink"].metrics
+                     if k == name and s == rd), None)
+
+    # The tier's whole contract, asserted where the numbers are minted:
+    # a disk-leg rate for DIFFERENT picks would be a benchmark of a
+    # different experiment.
+    assert disk["al_set_kind"] == "DiskPool", (
+        f"--pool_backend disk resolved to {disk['al_set_kind']} — the "
+        "leg never left host memory, so there is nothing to measure")
+    assert np.array_equal(mem["labeled"], disk["labeled"]), (
+        "disk backend picked different rows than memory — the paging "
+        "tier broke bit-identity (DESIGN.md §16)")
+    assert mem["acc"] == disk["acc"], (
+        f"accuracy diverged across backends: memory {mem['acc']} vs "
+        f"disk {disk['acc']} over identical picks")
+    disk_rows = gauge(disk, "pool_disk_rows", 1)
+    assert disk_rows, ("the disk leg emitted no paging telemetry — "
+                       "PAGING_GAUGES never saw a disk-backed round")
+
+    def ips_of(run):
+        # Round 1 trains on 2*budget labeled rows (init_pool_size=0).
+        train_sec = gauge(run, "rd_train_time", 1)
+        return (2 * budget * epochs / train_sec) if train_sec else None
+
+    ips, ips_mem = ips_of(disk), ips_of(mem)
+    return {
+        "phase": "disk_pool_feed",
+        "ips": round(ips, 1) if ips is not None else None,
+        "ips_per_chip": (round(ips / n_chips, 1) if ips is not None
+                         else None),
+        "unit": "train images/sec (disk-backed pool)",
+        "n_chips": n_chips,
+        "pool_n": pool_n,
+        "budget": budget,
+        "epochs": epochs,
+        "pool_bytes": pool_bytes,
+        "resident_budget_bytes": budget_bytes,
+        "pool_over_budget_x": round(pool_bytes / budget_bytes, 1),
+        # The paging tax, directly: the same fit on the same picks under
+        # the in-memory backend — vs_mem < 1 is what the disk tier costs.
+        "ips_memory": (round(ips_mem, 1) if ips_mem is not None
+                       else None),
+        "disk_vs_memory": (round(ips / ips_mem, 3)
+                           if ips and ips_mem else None),
+        # Warm-round paging evidence from the driver's PAGING_GAUGES.
+        "cache_hit_frac": gauge(disk, "pool_cache_hit_frac", 1),
+        "page_in_rows_per_sec": gauge(disk, "page_in_rows_per_sec", 1),
+        "page_stall_ms_p50": gauge(disk, "page_in_stall_ms_p50", 1),
+        "page_stall_ms_p99": gauge(disk, "page_in_stall_ms_p99", 1),
+        "pool_disk_rows": disk_rows,
+        "picks_identical": True,  # asserted above; recorded as evidence
+        "test_accuracy_rd1": gauge(disk, "rd_test_accuracy", 1),
+        "total_sec": round(mem["total_sec"] + disk["total_sec"], 1),
+        "device_kind": device_kind,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def _phase_setup(config: str, batch_size: int):
     """Shared model/trainer/batch construction for the timing child and
     the CPU FLOPs child: the batch schema and step signatures live in ONE
@@ -2010,6 +2187,9 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         return
     if phase == "stream_round":
         yield run_stream_phase(iters, per_chip)
+        return
+    if phase == "disk_pool_feed":
+        yield run_disk_pool_feed_phase(iters)
         return
     config, kind = phase.rsplit("_", 1)
     n_chips = len(jax.devices())
@@ -2554,6 +2734,17 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                          *((("ack_p99_ms", "ack_p99"),
                             ("trigger_cause", "trigger"))
                            if name == "stream_round" else ()),
+                         # The disk tier's riders (ISSUE 16): the warm
+                         # block-cache hit fraction and the page-in
+                         # stall tail — a disk-backed train rate is
+                         # ambiguous without knowing how often the
+                         # gather actually touched disk and what the
+                         # misses cost.  The finer figures (page-in
+                         # rate, p50, the memory-leg comparison) stay
+                         # in the evidence file.
+                         *((("cache_hit_frac", "hit"),
+                            ("page_stall_ms_p99", "stall_ms"))
+                           if name == "disk_pool_feed" else ()),
                          # The resident-pool layout rides the line only
                          # where it is the phase's SUBJECT (the
                          # sharded-ceiling probe) — a row-sharded max-N
@@ -2661,7 +2852,13 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
         compact["failed"] = {n: str(m)[:40] for n, m in failed.items()}
 
     def dumps(o):
-        return json.dumps(_sanitize(o), allow_nan=False)
+        # Compact separators: the margin accounting at MAX_LINE_BYTES
+        # counts spellings like '"ack_p99":NNN.NNN,' — json's default
+        # ", "/": " separators were silently spending one tail byte per
+        # key and comma (~150 bytes across the 15-phase rich form) that
+        # the accounting never budgeted.
+        return json.dumps(_sanitize(o), allow_nan=False,
+                          separators=(",", ":"))
 
     line = dumps(compact)
     if len(line) > MAX_LINE_BYTES and failed:

@@ -63,6 +63,10 @@ GATED_METRICS = (
     # (metric, phase predicate, "lower"|"higher" is better)
     ("warm_s", lambda name: name.startswith("al_round"), "lower"),
     ("ips_per_chip", lambda name: name.endswith("_train"), "higher"),
+    # The disk tier (ISSUE 16): the demand-paged backend's in-loop
+    # train rate — a pager regression (cache thrash, stall growth)
+    # lands here even when the in-memory phases stay flat.
+    ("ips_per_chip", lambda name: name == "disk_pool_feed", "higher"),
 )
 
 # Alias chains, newest spelling first — schema drift across bench

@@ -1,0 +1,29 @@
+"""Golden negative case for the disk-pool-paging checker: paging-path
+functions (named by the closed ``_PAGED_READERS`` registry) that
+materialize the whole store — the constructor, subscript, and method
+spellings of the same full-pool copy — plus a registered name no code
+defines (registry drift)."""
+
+import numpy as np
+
+_PAGED_READERS = ("rogue_gather", "rogue_spill", "rogue_block",
+                  "never_defined")
+
+
+class RoguePool:
+    def rogue_gather(self, idxs):
+        whole = np.asarray(self._mm)  # whole-store copy in one call
+        return whole[idxs]
+
+    def rogue_block(self, b):
+        return self._mm[:].copy()  # full slice AND .copy() — two reds
+
+
+def rogue_spill(mm, source):
+    rows = mm.tolist()  # the store as a python list: RAM times four
+    return rows
+
+
+def bounded_is_fine(mm, lo, hi):
+    # Not registered, and bounded slices never flag anyway.
+    return mm[lo:hi]

@@ -84,7 +84,7 @@ def run_fixture(check_id: str):
 
 class TestPackageClean:
     def test_full_run_clean_fast_single_parse(self):
-        """THE tier-1 gate: 16 checks over the whole package — zero
+        """THE tier-1 gate: 17 checks over the whole package — zero
         unsuppressed findings, every suppression carries a reason, the
         run fits the 5 s budget, and no file parses twice."""
         report = run_package_analysis()

@@ -73,6 +73,8 @@ class TestDegradedModeLine:
         # ... and the streaming loop (ISSUE 14): the 14th phase rides
         # the same degraded-line guarantee as the other 13.
         assert "stream_round" in out["failed"]
+        # ... and the disk tier (ISSUE 16): the 15th phase too.
+        assert "disk_pool_feed" in out["failed"]
         # The full evidence file landed in the REDIRECTED dir and is
         # itself strict-parseable.
         assert out["evidence"] == str(tmp_path / "bench_evidence.json")
@@ -260,6 +262,46 @@ class TestDegradedModeLine:
         # A streamed-ingest rate must never be billed as the training
         # headline.
         assert not out["metric"].startswith("stream_round")
+
+    def test_disk_pool_feed_riders_on_the_line(self, tmp_path):
+        """The disk tier's compact-line riders (ISSUE 16): the warm
+        block-cache hit fraction and the page-in stall tail ride the
+        line (a disk-backed train rate is ambiguous without them); the
+        finer paging figures (page-in rate, p50, the memory-leg
+        comparison) stay in the evidence file.  The MAX_LINE_BYTES
+        margin math at bench.MAX_LINE_BYTES accounts for ~60 bytes of
+        phase entry + riders."""
+        cache = {
+            "disk_pool_feed": {
+                "phase": "disk_pool_feed", "ips": 3120.4,
+                "ips_per_chip": 3120.4,
+                "unit": "train images/sec (disk-backed pool)",
+                "n_chips": 1, "device_kind": "cpu", "platform": "cpu",
+                "batch_per_chip": 64, "pool_n": 50000,
+                "pool_over_budget_x": 4.0,
+                "cache_hit_frac": 0.982, "page_stall_ms_p99": 41.75,
+                "page_stall_ms_p50": 3.2,
+                "page_in_rows_per_sec": 51200.5,
+                "pool_disk_rows": 50000, "ips_memory": 3600.0,
+                "disk_vs_memory": 0.867, "picks_identical": True,
+                "captured_utc": "2026-01-01T00:00:00Z",
+            }
+        }
+        (tmp_path / "bench_cache.json").write_text(json.dumps(cache))
+        proc = _run_bench(tmp_path)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        dp = out["phases"]["disk_pool_feed"]
+        assert dp["ips"] == pytest.approx(3120.4)
+        assert dp["hit"] == pytest.approx(0.982)
+        assert dp["stall_ms"] == pytest.approx(41.75)
+        # Off the bounded line, in the evidence file only.
+        for key in ("page_in_rows_per_sec", "page_stall_ms_p50",
+                    "ips_memory", "disk_vs_memory", "pool_disk_rows"):
+            assert key not in dp
+        # A disk-backed feed rate must never be billed as the training
+        # headline.
+        assert not out["metric"].startswith("disk_pool_feed")
 
     def test_legacy_ips_warm_alias_no_longer_rides(self, tmp_path):
         """A pre-rename cache entry carrying ONLY the deprecated

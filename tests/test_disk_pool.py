@@ -1,0 +1,383 @@
+"""The disk tier (data/diskpool.py, DESIGN.md §16).
+
+The disk tier's one non-negotiable claim is bit-identity: a pool paged
+off disk through the bounded block cache serves EXACTLY the bytes the
+in-memory array held, so picks, metrics, and experiment_state match the
+memory backend to the bit.  Pinned here:
+
+  * ``_DiskPoolCore.gather`` bit-identity against the spilled array for
+    every access shape (random, repeated, cross-block, partial tail
+    block, empty);
+  * the LRU block cache honors its byte budget (evictions, recency,
+    ``peak_cache_bytes`` bounded) and ``take_round_stats`` drains and
+    resets per round;
+  * the spy contract — no paging path ever materializes the pool on one
+    host (``max_read_rows`` stays one block, ``peak_cache_bytes`` stays
+    far under the pool) and ``.images`` raises so every
+    ``getattr(ds, "images", None)`` gate routes to streaming paths;
+  * ``resolve_pool_backend``'s ONE rule and ``page_rows_for``'s bucket
+    alignment;
+  * page_read chaos: raise / torn / delay through the ONE RetryPolicy —
+    a mid-read fault retries to a bit-identical block, a torn read can
+    never serve rows (the fault fires BEFORE the cache insert);
+  * the acceptance e2e: the FULL driver, 2 rounds on the multi-device
+    CPU mesh, a pool 4x the residency budget, memory vs disk backend
+    bit-identical for Margin AND Coreset — with the paging gauges in
+    the metrics stream, zero warm-round jit misses, and a mid-round
+    page-read fault that completes bit-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from active_learning_tpu import faults
+from active_learning_tpu.config import ExperimentConfig, TelemetryConfig
+from active_learning_tpu.data import diskpool
+from active_learning_tpu.data.diskpool import (DiskPool, _DiskPoolCore,
+                                               page_rows_for,
+                                               resolve_pool_backend,
+                                               spill_rows, wrap_pool)
+from active_learning_tpu.data.synthetic import get_data_synthetic
+from active_learning_tpu.experiment import arg_pools  # noqa: F401
+from active_learning_tpu.experiment.driver import run_experiment
+from active_learning_tpu.pool import bucket_size
+from active_learning_tpu.utils.metrics import JsonlSink
+
+from helpers import TinyClassifier, tiny_train_config
+
+SHAPE = (8, 8, 3)
+ROW_BYTES = int(np.prod(SHAPE))  # uint8
+BLOCK_ROWS = 64  # page_rows_for(64) == 64: the extent-ladder floor
+BLOCK_BYTES = BLOCK_ROWS * ROW_BYTES
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.configure(None)
+
+
+def _make_core(tmp_path, n_rows=300, page_rows=BLOCK_ROWS,
+               host_cache_bytes=1 << 30, local_rows=None, seed=3):
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 256, size=(n_rows, *SHAPE), dtype=np.uint8)
+    core = _DiskPoolCore(str(tmp_path / "pool_rows.u8"), n_rows, SHAPE,
+                         page_rows=page_rows,
+                         host_cache_bytes=host_cache_bytes,
+                         local_rows=local_rows)
+    core.create(arr)
+    return core, arr
+
+
+class TestGatherBitIdentity:
+    def test_every_access_shape_matches_the_source(self, tmp_path):
+        core, arr = _make_core(tmp_path)  # 300 rows: block 4 is partial
+        rng = np.random.default_rng(0)
+        for idxs in (
+            np.arange(300),                         # full scan in order
+            rng.permutation(300),                   # full shuffle
+            rng.integers(0, 300, size=97),          # repeats, cross-block
+            np.array([0, 63, 64, 255, 256, 299]),   # block boundaries
+            np.array([7]),                          # single row
+            np.array([], dtype=np.int64),           # empty
+        ):
+            assert np.array_equal(core.gather(idxs), arr[idxs])
+
+    def test_partial_tail_block_rows(self, tmp_path):
+        core, arr = _make_core(tmp_path)
+        # Rows 256..299 live in a 44-row tail block — bounded to the
+        # store's end, never padded or over-read.
+        out = core.gather(np.arange(256, 300))
+        assert out.shape == (44, *SHAPE)
+        assert np.array_equal(out, arr[256:300])
+        assert core.spy_counters()["max_read_rows"] == 44
+
+    def test_local_rows_out_of_span_raises(self, tmp_path):
+        core, arr = _make_core(tmp_path, local_rows=slice(64, 128))
+        idxs = np.arange(64, 128)
+        assert np.array_equal(core.gather(idxs), arr[idxs])
+        with pytest.raises(IndexError, match="process-local"):
+            core.gather(np.array([10]))
+        with pytest.raises(IndexError, match="process-local"):
+            core.gather(np.array([70, 128]))
+
+
+class TestBlockCache:
+    def test_budget_bounds_and_lru_recency(self, tmp_path):
+        core, arr = _make_core(tmp_path, host_cache_bytes=2 * BLOCK_BYTES)
+        for b in (0, 1, 2):  # fill past the 2-block budget
+            core.gather(np.array([b * BLOCK_ROWS]))
+        assert set(core._blocks) == {1, 2}
+        core.gather(np.array([BLOCK_ROWS]))      # touch 1 -> MRU
+        core.gather(np.array([3 * BLOCK_ROWS]))  # page 3 -> evict 2
+        assert set(core._blocks) == {1, 3}
+        assert core._cache_bytes <= 2 * BLOCK_BYTES
+        # Evicted block 2 pages back in bit-identical.
+        assert np.array_equal(core.gather(np.arange(128, 192)),
+                              arr[128:192])
+        assert core.spy_counters()["peak_cache_bytes"] <= 2 * BLOCK_BYTES
+
+    def test_single_block_cache_never_empties(self, tmp_path):
+        # A budget smaller than one block still caches exactly one
+        # block (the len > 1 eviction guard) — thrashing, not breaking.
+        core, arr = _make_core(tmp_path,
+                               host_cache_bytes=BLOCK_BYTES // 2)
+        idxs = np.concatenate([np.arange(0, 64), np.arange(64, 128),
+                               np.arange(0, 64)])
+        assert np.array_equal(core.gather(idxs), arr[idxs])
+        assert len(core._blocks) == 1
+
+    def test_round_stats_drain_and_reset(self, tmp_path):
+        core, _ = _make_core(tmp_path)
+        rng = np.random.default_rng(1)
+        core.gather(rng.integers(0, 300, size=200))
+        core.gather(np.arange(0, 64))  # guaranteed hits
+        stats = core.take_round_stats()
+        assert stats["pool_disk_rows"] == 300.0
+        assert 0.0 < stats["pool_cache_hit_frac"] <= 1.0
+        assert stats["page_in_rows_per_sec"] > 0
+        assert stats["page_in_stall_ms_p99"] >= stats["page_in_stall_ms_p50"]
+        # Drained: the next round reports its OWN window — None gauges
+        # (retracted at the sinks), absolute disk rows unchanged.
+        stats2 = core.take_round_stats()
+        assert stats2["pool_disk_rows"] == 300.0
+        for k in ("pool_cache_hit_frac", "page_in_rows_per_sec",
+                  "page_in_stall_ms_p50", "page_in_stall_ms_p99"):
+            assert stats2[k] is None
+
+
+class TestSpyNoFullMaterialization:
+    def test_full_shuffled_scan_stays_block_bounded(self, tmp_path):
+        core, arr = _make_core(tmp_path, n_rows=1024,
+                               host_cache_bytes=4 * BLOCK_BYTES)
+        rng = np.random.default_rng(2)
+        order = rng.permutation(1024)
+        for c in range(0, 1024, 96):  # epoch-style chunked scan
+            chunk = order[c:c + 96]
+            assert np.array_equal(core.gather(chunk), arr[chunk])
+        spy = core.spy_counters()
+        assert spy["max_read_rows"] <= BLOCK_ROWS
+        assert spy["peak_cache_bytes"] <= 4 * BLOCK_BYTES
+        assert spy["peak_cache_bytes"] < 1024 * ROW_BYTES // 2
+
+    def test_images_raises_and_gates_route_away(self, tmp_path):
+        train_set, _, al_set = get_data_synthetic(
+            n_train=96, n_test=16, num_classes=4, image_size=8, seed=5)
+        train_dp, al_dp = wrap_pool(train_set, al_set,
+                                    str(tmp_path / "dp"))
+        assert train_dp._core is al_dp._core  # ONE extent, ONE cache
+        with pytest.raises(AttributeError, match="gather"):
+            _ = train_dp.images
+        # The exact gate expression every residency/feed consumer uses.
+        assert getattr(train_dp, "images", None) is None
+        assert train_dp.paged_backend is True
+        assert len(train_dp) == 96
+        view_dp = train_dp.with_view(al_set.view)
+        assert view_dp._core is train_dp._core
+        idxs = np.arange(0, 96, 7)
+        assert np.array_equal(train_dp.gather(idxs),
+                              train_set.images[idxs])
+
+    def test_wrap_pool_needs_an_in_memory_source(self, tmp_path):
+        class NoImages:
+            pass
+
+        with pytest.raises(ValueError, match="in-memory"):
+            wrap_pool(NoImages(), NoImages(), str(tmp_path / "dp"))
+
+
+class TestBackendRule:
+    def test_explicit_backends_win(self):
+        assert resolve_pool_backend("memory", 1 << 60) == "memory"
+        assert resolve_pool_backend("disk", 1) == "disk"
+
+    def test_auto_crosses_the_watermark(self, monkeypatch):
+        monkeypatch.setattr(diskpool, "host_ram_bytes", lambda: 1000)
+        assert resolve_pool_backend("auto", 499) == "memory"
+        assert resolve_pool_backend("auto", 501) == "disk"
+        assert resolve_pool_backend("auto", 200,
+                                    watermark_frac=0.1) == "disk"
+        # Unknown RAM -> never auto-select the disk tier.
+        monkeypatch.setattr(diskpool, "host_ram_bytes", lambda: 0)
+        assert resolve_pool_backend("auto", 1 << 60) == "memory"
+
+    def test_bad_backend_rejected(self):
+        with pytest.raises(ValueError, match="auto/memory/disk"):
+            resolve_pool_backend("ramdisk", 1)
+
+    def test_page_rows_snap_to_the_extent_ladder(self):
+        for req in (1, 17, 64, 65, 300, 2048, 5000):
+            assert page_rows_for(req) == bucket_size(max(req, 1),
+                                                     floor=64)
+
+    def test_spill_rows_blocked_writes(self, tmp_path):
+        rng = np.random.default_rng(4)
+        arr = rng.integers(0, 256, size=(150, *SHAPE), dtype=np.uint8)
+        path = str(tmp_path / "spill.u8")
+        with open(path, "wb") as fh:
+            fh.truncate(arr.nbytes)
+        mm = np.memmap(path, dtype=np.uint8, mode="r+",
+                       shape=arr.shape)
+        spill_rows(mm, arr, 0, 150, block_rows=64)  # partial last block
+        assert np.array_equal(np.asarray(mm), arr)
+
+        class Gatherable:  # the non-ndarray source arm
+            def gather(self, idxs):
+                return arr[idxs]
+
+        mm2 = np.memmap(str(tmp_path / "spill2.u8"), dtype=np.uint8,
+                        mode="w+", shape=arr.shape)
+        spill_rows(mm2, Gatherable(), 0, 150, block_rows=64)
+        assert np.array_equal(np.asarray(mm2), arr)
+
+
+class TestPageReadChaos:
+    def test_raise_mid_round_retries_bit_identical(self, tmp_path):
+        core, arr = _make_core(tmp_path)
+        before = faults.retry_counters()["by_site"].get("page_read", 0)
+        faults.configure("page_read:raise@2", seed=7)
+        idxs = np.arange(0, 192)  # 3 block reads; the 2nd one faults
+        assert np.array_equal(core.gather(idxs), arr[idxs])
+        assert faults.fault_counters()["page_read"]["fires"] == 1
+        after = faults.retry_counters()["by_site"].get("page_read", 0)
+        assert after == before + 1
+
+    def test_torn_read_never_serves_rows(self, tmp_path):
+        core, arr = _make_core(tmp_path)
+        faults.configure("page_read:torn@1", seed=7)
+        # The torn point fires BETWEEN the block's two half-reads —
+        # before the cache insert, so the retried read (and everything
+        # after) is bit-identical and no partial block is ever cached.
+        idxs = np.arange(0, 64)
+        assert np.array_equal(core.gather(idxs), arr[idxs])
+        assert faults.fault_counters()["page_read"]["fires"] == 1
+        for blk_id, blk in core._blocks.items():
+            assert blk.shape[0] == 64, "a torn block entered the cache"
+        assert np.array_equal(core.gather(idxs), arr[idxs])  # cache hit
+
+    def test_delay_lands_in_the_stall_percentiles(self, tmp_path):
+        core, arr = _make_core(tmp_path)
+        faults.configure("page_read:delay@0.01", seed=7)
+        idxs = np.arange(0, 128)
+        assert np.array_equal(core.gather(idxs), arr[idxs])
+        stats = core.take_round_stats()
+        assert stats["page_in_stall_ms_p50"] >= 10.0
+
+
+# -- end-to-end: memory vs disk backend bit-identity -------------------------
+
+POOL_N = 256
+POOL_BYTES = POOL_N * ROW_BYTES                  # 49152
+RESIDENT_BUDGET = POOL_BYTES // 4                # pool is 4x the budget
+
+
+def _run_e2e(tmp_path, name: str, sampler: str, backend: str,
+             fault_spec=None):
+    cfg = ExperimentConfig(
+        dataset="synthetic", arg_pool="synthetic", strategy=sampler,
+        rounds=2, round_budget=8, n_epoch=3, early_stop_patience=3,
+        run_seed=7, exp_hash=name, exp_name="disk",
+        ckpt_path=str(tmp_path / f"ckpt_{name}"),
+        log_dir=str(tmp_path / f"logs_{name}"),
+        pool_backend=backend, fault_spec=fault_spec,
+        resident_scoring_bytes=RESIDENT_BUDGET,
+        telemetry=TelemetryConfig(enabled=True, heartbeat_every_s=0.0))
+    data = get_data_synthetic(n_train=POOL_N, n_test=32, num_classes=4,
+                              image_size=8, seed=5)
+    train_cfg = dataclasses.replace(
+        tiny_train_config(), pool_page_rows=BLOCK_ROWS,
+        pool_host_cache_bytes=RESIDENT_BUDGET)
+    sink = JsonlSink(cfg.log_dir, experiment_key=name)
+    strategy = run_experiment(cfg, sink=sink, data=data,
+                              train_cfg=train_cfg,
+                              model=TinyClassifier(num_classes=4))
+    state_path = glob.glob(os.path.join(cfg.ckpt_path, "*",
+                                        "experiment_state.npz"))[0]
+    metrics = []
+    with open(os.path.join(cfg.log_dir, "metrics.jsonl")) as fh:
+        for line in fh:
+            metrics.append(json.loads(line))
+    return strategy, dict(np.load(state_path)), metrics
+
+
+def _metric_series(events, name):
+    return [(ev.get("step"), ev["metrics"][name]) for ev in events
+            if ev.get("kind") == "metric"
+            and ev.get("metrics", {}).get(name) is not None]
+
+
+class TestDiskBackendBitIdentity:
+    @pytest.mark.parametrize("sampler", ["MarginSampler", "CoresetSampler"])
+    def test_disk_pool_bit_identical_to_memory(self, tmp_path, sampler):
+        """The acceptance pin: the FULL driver, 2 rounds on the
+        multi-device CPU mesh, a pool exactly 4x both residency budgets
+        (HBM scoring + host block cache) — every experiment_state array
+        and per-round test metric identical to the bit across backends,
+        with the spy counters proving the disk leg never materialized
+        the pool and the warm round compiling nothing new."""
+        mem, mem_state, mem_metrics = _run_e2e(
+            tmp_path, f"mem_{sampler}", sampler, "memory")
+        disk, disk_state, disk_metrics = _run_e2e(
+            tmp_path, f"disk_{sampler}", sampler, "disk")
+        assert type(mem.al_set).__name__ != "DiskPool"
+        assert type(disk.al_set).__name__ == "DiskPool"
+
+        assert set(mem_state) == set(disk_state)
+        for k in mem_state:
+            assert np.array_equal(mem_state[k], disk_state[k]), (
+                f"experiment_state[{k!r}] diverged on the disk tier")
+        assert _metric_series(mem_metrics, "rd_test_accuracy")
+        for metric in ("rd_test_accuracy", "rd_test_loss"):
+            m = _metric_series(mem_metrics, metric)
+            d = _metric_series(disk_metrics, metric)
+            if m or d:
+                assert m == d, metric
+
+        # The spy contract, on the production run: reads stayed one
+        # block, the cache stayed within budget, nothing approached the
+        # pool's footprint.
+        spy = disk.al_set.spy_counters()
+        assert 0 < spy["max_read_rows"] <= BLOCK_ROWS
+        assert spy["peak_cache_bytes"] <= RESIDENT_BUDGET + BLOCK_BYTES
+        assert spy["peak_cache_bytes"] < POOL_BYTES // 2
+
+        # The paging gauges landed in the metrics stream ...
+        disk_rows = _metric_series(disk_metrics, "pool_disk_rows")
+        assert disk_rows and all(v == POOL_N for _, v in disk_rows)
+        assert _metric_series(disk_metrics, "pool_cache_hit_frac")
+        # ... and never in the memory run's.
+        assert not _metric_series(mem_metrics, "pool_disk_rows")
+
+        # Warm rounds must not compile: paging changed storage, not
+        # shapes — the round-1 jit miss delta is 0, as on memory.
+        deltas = dict(_metric_series(disk_metrics,
+                                     "jit_cache_miss_delta"))
+        assert deltas[1] == 0, f"round-1 jit cache misses: {deltas[1]}"
+
+    def test_mid_round_page_fault_completes_bit_identical(self, tmp_path):
+        """The satellite chaos case: a page-read fault in the middle of
+        a live round goes through the ONE RetryPolicy and the run
+        completes with experiment_state bit-identical to the unfaulted
+        disk run — the fault is visible only in fault_retries_total."""
+        clean, clean_state, _ = _run_e2e(
+            tmp_path, "chaos_clean", "MarginSampler", "disk")
+        before = faults.retry_counters()["by_site"].get("page_read", 0)
+        faulted, faulted_state, faulted_metrics = _run_e2e(
+            tmp_path, "chaos_fault", "MarginSampler", "disk",
+            fault_spec="page_read:raise@2")
+        after = faults.retry_counters()["by_site"].get("page_read", 0)
+        assert after == before + 1, "the injected fault never fired"
+        assert type(faulted.al_set).__name__ == "DiskPool"
+        assert set(clean_state) == set(faulted_state)
+        for k in clean_state:
+            assert np.array_equal(clean_state[k], faulted_state[k]), (
+                f"experiment_state[{k!r}] diverged under the fault")
+        retries = _metric_series(faulted_metrics, "fault_retries_total")
+        assert retries and max(v for _, v in retries) >= 1

@@ -831,6 +831,24 @@ class TestBenchEvidence:
                          qps_closed=137.2, p99_ms_closed=25.0,
                          request_path_compiles=0,
                          batch_occupancy={"8": {"4": 64, "8": 236}})
+        if name == "stream_round":
+            # The streaming phase's line riders (ISSUE 14) plus its
+            # file-only figures — absent from this fixture until ISSUE
+            # 16 made the maximal pin actually cover the margin math.
+            extra.update(unit="ingested rows/sec (acked)",
+                         ack_p99_ms=142.375, trigger_cause="watermark",
+                         ingest_qps=250.1, ack_p50_ms=2.8,
+                         pool_rows_final=6304)
+        if name == "disk_pool_feed":
+            # The disk tier (ISSUE 16): hit fraction + stall tail ride
+            # the line; the rest is evidence-file-only.
+            extra.update(unit="train images/sec (disk-backed pool)",
+                         cache_hit_frac=0.982, page_stall_ms_p99=41.75,
+                         page_stall_ms_p50=3.2,
+                         page_in_rows_per_sec=51200.5,
+                         pool_disk_rows=50000, pool_over_budget_x=4.0,
+                         ips_memory=4100.2, disk_vs_memory=0.873,
+                         picks_identical=True)
         return self._entry(name, **extra)
 
     def test_compact_line_bounded_all_phases_full(self, capsys, tmp_path):
@@ -857,6 +875,12 @@ class TestBenchEvidence:
         assert out["phases"]["al_round_cifar"]["retries"] == 12
         assert out["phases"]["al_round_cifar"]["degraded"] == 3
         assert out["phases"]["imagenet_datapath"]["warm_ips"] == 9000.1
+        # The disk tier's riders (ISSUE 16) ride in rich form alongside
+        # everything above — the 15-phase maximal line still fits.
+        assert out["phases"]["disk_pool_feed"]["hit"] == 0.982
+        assert out["phases"]["disk_pool_feed"]["stall_ms"] == 41.75
+        assert "disk_vs_memory" not in out["phases"]["disk_pool_feed"]
+        assert out["phases"]["stream_round"]["ack_p99"] == 142.375
         # The file carries what the line dropped.
         with open(bench.EVIDENCE_PATH) as fh:
             full = json.load(fh)

@@ -93,7 +93,8 @@ class TestSpecGrammar:
         # rename shows up here too.
         assert faults.SITES == ("h2d_upload", "ckpt_write", "spec_scorer",
                                 "feed_worker", "shard_upload", "dispatch",
-                                "grad_probe", "wal_write", "stream_drain")
+                                "grad_probe", "wal_write", "stream_drain",
+                                "page_read")
 
 
 # ---------------------------------------------------------------------------
