@@ -2,8 +2,9 @@
 (README.md:53).  Extra verbs beyond the reference surface: ``serve``
 (the online scoring service, serve/cli.py), ``stream`` (continual
 ingest -> score -> select on one persistent mesh, stream/cli.py),
-``status`` (live run summary), and ``report`` (label-efficiency
-curves)."""
+``status`` (live run summary), ``report`` (label-efficiency
+curves), and ``fleet`` (many experiments on preemptible capacity —
+the sweep controller, fleet/cli.py)."""
 
 from .experiment.cli import main
 

@@ -1,8 +1,8 @@
 """Whole-package static analysis (DESIGN.md §12).
 
-One engine, one parse per file, 17 checks: the 10 invariants the old
+One engine, one parse per file, 18 checks: the 10 invariants the old
 ``scripts/trace_lint.py`` monolith enforced (ported verbatim — same
-verdicts, same messages) plus seven deep checkers targeting the bug
+verdicts, same messages) plus eight deep checkers targeting the bug
 classes three consecutive PRs of code review kept re-finding:
 
   lock-discipline    _GUARDED_BY fields only touched under their lock

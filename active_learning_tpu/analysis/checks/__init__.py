@@ -1,4 +1,4 @@
-"""The checker registry: 10 ported legacy checks + 7 deep checkers.
+"""The checker registry: 10 ported legacy checks + 8 deep checkers.
 
 Ordered — the CLI lists and runs them in this order, and the per-check
 fixture test parametrizes over it.  Adding a check = appending here
@@ -15,6 +15,7 @@ from .collective_axis import CollectiveAxisChecker
 from .diagnostics_inert import DiagnosticsInertChecker
 from .wal_before_ack import WalBeforeAckChecker
 from .disk_pool_paging import DiskPoolPagingChecker
+from .fleet_host_pure import FleetHostPureChecker
 
 DEEP_CHECKERS = (
     LockDisciplineChecker(),
@@ -24,6 +25,7 @@ DEEP_CHECKERS = (
     DiagnosticsInertChecker(),
     WalBeforeAckChecker(),
     DiskPoolPagingChecker(),
+    FleetHostPureChecker(),
 )
 
 CHECKERS = tuple(LEGACY_CHECKERS) + DEEP_CHECKERS

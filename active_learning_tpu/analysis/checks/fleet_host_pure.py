@@ -1,0 +1,160 @@
+"""fleet-host-pure: the fleet layer never touches jax, and its journal
+writes cannot tear.
+
+The fleet controller (active_learning_tpu/fleet/, DESIGN.md §17) runs
+on a CPU-only head node scheduling experiments onto workers whose
+accelerators it can never initialize — one ``import jax`` anywhere in
+the package and the controller dies at import time on exactly the
+machine it exists for.  And its single source of truth, the fleet
+journal, is only crash-safe because every write goes through ONE
+atomic tmp+rename helper; a second ``json.dump`` path added in a hurry
+would reintroduce the torn-write corruption the journal design exists
+to rule out.  Both properties are structural, so this checker proves
+them statically:
+
+  1. **Host purity.**  A module declaring ``_FLEET_MODULE = True``
+     (every module in the fleet package — the closed registry) may not
+     import jax in any form or reference the ``jax`` name.  stdlib
+     only: the controller consumes heartbeats, journals, and scrape
+     files — never arrays.
+
+  2. **Atomic journal writes.**  Inside a marked module, every
+     ``json.dump`` call must sit lexically inside a function named
+     ``write_atomic_json``, and every such function must contain the
+     ``os.replace`` that makes it atomic.  (``json.dumps`` to a string
+     is fine — only the direct-to-file spelling can tear.)
+
+  3. **Coverage.**  Every ``.py`` under ``active_learning_tpu/fleet/``
+     must declare the marker — a new fleet module cannot opt out of
+     rules 1–2 by forgetting the registry line.
+
+Like its siblings the walk is LEXICAL: ``from json import dump`` would
+evade rule 2's name match — don't do that (review owns renames; the
+checker owns the honest spelling).
+
+Suppression: ``# al-lint: fleet-ok <reason>`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from ..engine import Checker, Context
+from ..findings import Finding
+
+
+def _declares_fleet(tree: ast.Module) -> bool:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            names = {t.id for t in node.targets
+                     if isinstance(t, ast.Name)}
+            if "_FLEET_MODULE" in names:
+                return (isinstance(node.value, ast.Constant)
+                        and node.value.value is True)
+    return False
+
+
+class FleetHostPureChecker(Checker):
+    id = "fleet-host-pure"
+    title = ("the fleet layer (_FLEET_MODULE registry) never imports jax "
+             "and journals only through the atomic tmp+rename helper")
+    suppress_token = "fleet-ok"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        problems: List[Finding] = []
+        for path in ctx.files:
+            tree, err = ctx.tree(path)
+            if err is not None:
+                continue  # parse failures are the legacy checks' finding
+            rel = ctx.rel(path)
+            in_fleet = ("active_learning_tpu/fleet/"
+                        in rel.replace(os.sep, "/"))
+            marked = _declares_fleet(tree)
+            if in_fleet and not marked:
+                problems.append(Finding(
+                    check=self.id, path=rel, line=1,
+                    message=("module under active_learning_tpu/fleet/ "
+                             "does not declare '_FLEET_MODULE = True' — "
+                             "every fleet module joins the closed "
+                             "registry so none can opt out of the "
+                             "host-purity and atomic-journal rules"),
+                    hint="add '_FLEET_MODULE = True' at module level"))
+            if marked:
+                self._check_host_pure(tree, rel, problems)
+                self._check_atomic_journal(tree, rel, problems)
+        return problems
+
+    # -- rule 1: host purity ----------------------------------------------
+
+    def _check_host_pure(self, tree, rel, problems):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "jax":
+                        problems.append(self._pure_finding(
+                            rel, node.lineno,
+                            "imports jax — the fleet layer runs on a "
+                            "CPU-only head node that can never "
+                            "initialize a worker's accelerator"))
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "jax":
+                    problems.append(self._pure_finding(
+                        rel, node.lineno,
+                        "imports from jax — the fleet layer must stay "
+                        "stdlib-only"))
+            elif isinstance(node, ast.Name) and node.id == "jax":
+                problems.append(self._pure_finding(
+                    rel, node.lineno,
+                    "references the jax name inside a fleet module"))
+
+    def _pure_finding(self, rel, line, message):
+        return Finding(
+            check=self.id, path=rel, line=line,
+            message=f"host-purity violation: {message}",
+            hint="keep device work in the launched run children — the "
+                 "controller consumes heartbeats/journals/scrape files, "
+                 "or annotate '# al-lint: fleet-ok <reason>'")
+
+    # -- rule 2: atomic journal writes ------------------------------------
+
+    def _check_atomic_journal(self, tree, rel, problems):
+        def visit(node, inside_helper: bool):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name == "write_atomic_json":
+                    inside_helper = True
+                    if not any(
+                            isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Attribute)
+                            and n.func.attr == "replace"
+                            and isinstance(n.func.value, ast.Name)
+                            and n.func.value.id == "os"
+                            for n in ast.walk(node)):
+                        problems.append(Finding(
+                            check=self.id, path=rel, line=node.lineno,
+                            message=("'write_atomic_json' contains no "
+                                     "os.replace — the helper lost the "
+                                     "tmp+rename that makes journal "
+                                     "writes atomic"),
+                            hint="write to a tmp path, then os.replace "
+                                 "it over the journal"))
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "dump"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "json"
+                    and not inside_helper):
+                problems.append(Finding(
+                    check=self.id, path=rel, line=node.lineno,
+                    message=("json.dump outside 'write_atomic_json' — a "
+                             "fleet-package file write that can tear; "
+                             "the journal's crash-safety claim holds "
+                             "only through the one atomic helper"),
+                    hint="route the write through "
+                         "journal.write_atomic_json, or annotate "
+                         "'# al-lint: fleet-ok <reason>'"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, inside_helper)
+
+        visit(tree, False)

@@ -368,6 +368,12 @@ def main(argv: Optional[List[str]] = None):
     if argv and argv[0] == "report":
         from ..telemetry.report import main as report_main
         return report_main(argv[1:])
+    # ``fleet``: many experiments on preemptible capacity — the sweep
+    # controller (active_learning_tpu/fleet/, DESIGN.md §17).  Host-pure
+    # like ``status``/``report``: the head node never imports jax.
+    if argv and argv[0] == "fleet":
+        from ..fleet.cli import main as fleet_main
+        return fleet_main(argv[1:])
     from ..faults.preempt import PreemptionRequested
     from .driver import run_experiment
     args = get_parser().parse_args(argv)

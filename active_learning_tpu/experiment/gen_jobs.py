@@ -1,8 +1,18 @@
-"""Experiment-sweep job generator.
+"""Experiment-sweep job generator — shell commands OR fleet sweep specs.
 
-Prints the shell commands for the paper's experiment grids
-(reference: src/gen_jobs.py:3-145) against this package's CLI
-(``python -m active_learning_tpu``).  Three sweeps:
+The paper's experiment grids (reference: src/gen_jobs.py:3-145) against
+this package's CLI (``python -m active_learning_tpu``), from ONE grid
+definition with two renderings:
+
+  * ``--format shell`` (the default, and the reference's behavior):
+    print one pasteable command per experiment;
+  * ``--format fleet``: emit the same grid as a fleet sweep-spec JSON
+    (active_learning_tpu/fleet/spec.py) for
+    ``python -m active_learning_tpu fleet run --spec ...`` — the human-
+    paste path and the controller path can never drift, because both
+    render the same arg dicts through the same ``run_argv`` mapping.
+
+Three sweeps:
 
   * ImageNet linear evaluation — SSLResNet50, frozen features, 8 rounds x
     10k budget, 30k init pool, 50k/80k subsets, 10 partitions
@@ -12,14 +22,19 @@ Prints the shell commands for the paper's experiment grids
   * CIFAR-10 (balanced or imbalanced) — SSLResNet18, 30 rounds x 1k,
     200 epochs, patience 50 (gen_jobs.py:89-138).
 
-Run: ``python -m active_learning_tpu.experiment.gen_jobs [dataset_dir]``.
+Run: ``python -m active_learning_tpu.experiment.gen_jobs [dataset_dir]
+[--format shell|fleet] [--sweep NAME]``.
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import sys
 from itertools import product
-from typing import List, Sequence
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..fleet.spec import run_argv
 
 IMAGENET_STRATEGIES = (
     "RandomSampler", "BalancedRandomSampler", "MASESampler",
@@ -34,66 +49,95 @@ CIFAR_STRATEGIES = (
 CLI = "python -m active_learning_tpu"
 
 
-def _init_pool_flag(strategy: str) -> str:
-    pool_type = ("random_balance" if strategy == "BalancedRandomSampler"
-                 else "random")
-    return f"--init_pool_type {pool_type}"
+def _init_pool_type(strategy: str) -> str:
+    return ("random_balance" if strategy == "BalancedRandomSampler"
+            else "random")
 
 
-def imagenet_experiments(dataset_dir: str, arg_pool: str,
-                         extra: str = "") -> List[str]:
+def _render(args: Dict[str, Any]) -> str:
+    """One arg dict as the pasteable shell command — the same
+    args -> argv mapping the fleet controller launches with."""
+    return " ".join([CLI] + run_argv(args))
+
+
+def imagenet_args(dataset_dir: str, arg_pool: str,
+                  extra: Optional[Dict[str, Any]] = None
+                  ) -> List[Dict[str, Any]]:
+    """The ImageNet protocol's arg dicts, one per strategy.  Key order
+    is the flag order the printed commands have always had."""
     jobs = []
     for strategy in IMAGENET_STRATEGIES:
-        jobs.append(
-            f"{CLI} --dataset_dir {dataset_dir} "
-            f"--exp_name {strategy}_arg_{arg_pool}_imagenet_b10000 "
-            f"--dataset imagenet --arg_pool {arg_pool} "
-            f"--model SSLResNet50 --strategy {strategy} "
-            f"--rounds 8 --round_budget 10000 --init_pool_size 30000 "
-            f"--subset_labeled 50000 --subset_unlabeled 80000 "
-            f"--partitions 10 {extra}{_init_pool_flag(strategy)}")
+        jobs.append({
+            "dataset_dir": dataset_dir,
+            "exp_name": f"{strategy}_arg_{arg_pool}_imagenet_b10000",
+            "dataset": "imagenet", "arg_pool": arg_pool,
+            "model": "SSLResNet50", "strategy": strategy,
+            "rounds": 8, "round_budget": 10000,
+            "init_pool_size": 30000,
+            "subset_labeled": 50000, "subset_unlabeled": 80000,
+            "partitions": 10, **(extra or {}),
+            "init_pool_type": _init_pool_type(strategy)})
     return jobs
 
 
-def linear_evaluation_imagenet_experiments(dataset_dir: str) -> List[str]:
-    return imagenet_experiments(dataset_dir, "ssp_linear_evaluation",
-                                extra="--freeze_feature ")
+def linear_evaluation_imagenet_args(dataset_dir: str
+                                    ) -> List[Dict[str, Any]]:
+    return imagenet_args(dataset_dir, "ssp_linear_evaluation",
+                         extra={"freeze_feature": True})
 
 
-def end_to_end_imagenet_experiments_pretrained(dataset_dir: str
-                                               ) -> List[str]:
-    return imagenet_experiments(
-        dataset_dir, "ssp_finetuning",
-        extra="--early_stop_patience 30 --n_epoch 60 ")
+def end_to_end_imagenet_args_pretrained(dataset_dir: str
+                                        ) -> List[Dict[str, Any]]:
+    return imagenet_args(dataset_dir, "ssp_finetuning",
+                         extra={"early_stop_patience": 30, "n_epoch": 60})
 
 
-def cifar10_experiments(dataset_dir: str, number_of_runs: int = 1,
-                        n_epoch: int = 200, rounds: int = 30,
-                        imbalanced: bool = False,
-                        round_budgets: Sequence[int] = (1000,)) -> List[str]:
+def cifar10_args(dataset_dir: str, number_of_runs: int = 1,
+                 n_epoch: int = 200, rounds: int = 30,
+                 imbalanced: bool = False,
+                 round_budgets: Sequence[int] = (1000,)
+                 ) -> List[Dict[str, Any]]:
     if imbalanced:
         dataset = "imbalanced_cifar10"
         arg_pool = "ssp_finetuning_imbalanced_cifar10_imb_0_1"
-        imb = "--imbalance_factor 0.1 --imbalance_type exp "
+        imb: Dict[str, Any] = {"imbalance_factor": 0.1,
+                               "imbalance_type": "exp"}
     else:
         dataset = "cifar10"
         arg_pool = "ssp_finetuning"
-        imb = ""
+        imb = {}
     jobs = []
     for _, strategy, budget in product(range(number_of_runs),
                                        CIFAR_STRATEGIES, round_budgets):
         # --download_data makes every CIFAR job one-command on a fresh
         # machine (the reference gets this implicitly from torchvision
         # download=True, custom_cifar10.py:30-33).
-        jobs.append(
-            f"{CLI} --dataset_dir {dataset_dir} --download_data "
-            f"--exp_name {strategy}_arg_{arg_pool}_{dataset}_b{budget} "
-            f"--dataset {dataset} --arg_pool {arg_pool} "
-            f"--n_epoch {n_epoch} --early_stop_patience 50 "
-            f"--model SSLResNet18 --strategy {strategy} "
-            f"--rounds {rounds} --round_budget {budget} "
-            f"--init_pool_size {budget} {imb}{_init_pool_flag(strategy)}")
+        jobs.append({
+            "dataset_dir": dataset_dir, "download_data": True,
+            "exp_name": f"{strategy}_arg_{arg_pool}_{dataset}_b{budget}",
+            "dataset": dataset, "arg_pool": arg_pool,
+            "n_epoch": n_epoch, "early_stop_patience": 50,
+            "model": "SSLResNet18", "strategy": strategy,
+            "rounds": rounds, "round_budget": budget,
+            "init_pool_size": budget, **imb,
+            "init_pool_type": _init_pool_type(strategy)})
     return jobs
+
+
+# -- the shell rendering (the reference's surface, byte-stable) --------------
+
+def linear_evaluation_imagenet_experiments(dataset_dir: str) -> List[str]:
+    return [_render(a) for a in linear_evaluation_imagenet_args(dataset_dir)]
+
+
+def end_to_end_imagenet_experiments_pretrained(dataset_dir: str
+                                               ) -> List[str]:
+    return [_render(a)
+            for a in end_to_end_imagenet_args_pretrained(dataset_dir)]
+
+
+def cifar10_experiments(dataset_dir: str, **kwargs: Any) -> List[str]:
+    return [_render(a) for a in cifar10_args(dataset_dir, **kwargs)]
 
 
 def all_jobs(dataset_dir: str = "<YOUR DATASET DIR HERE>") -> List[str]:
@@ -103,10 +147,64 @@ def all_jobs(dataset_dir: str = "<YOUR DATASET DIR HERE>") -> List[str]:
             + cifar10_experiments(dataset_dir, imbalanced=True))
 
 
-def main(argv=None) -> None:
-    argv = sys.argv[1:] if argv is None else argv
-    dataset_dir = argv[0] if argv else "<YOUR DATASET DIR HERE>"
-    for job in all_jobs(dataset_dir):
+# -- the fleet rendering -----------------------------------------------------
+
+# Sweep name -> arg-dict builder.  init_pool_type varies per strategy,
+# so each sweep is a defaults + explicit-runs spec, not a pure grid.
+SWEEPS = {
+    "imagenet_linear": linear_evaluation_imagenet_args,
+    "imagenet_finetune": end_to_end_imagenet_args_pretrained,
+    "cifar10": lambda d: cifar10_args(d),
+    "imbalanced_cifar10": lambda d: cifar10_args(d, imbalanced=True),
+}
+
+
+def fleet_spec(dataset_dir: str, sweep: Optional[str] = None
+               ) -> Dict[str, Any]:
+    """The sweep(s) as ONE fleet sweep-spec JSON object: ``defaults``
+    carries the dataset dir; each job is an explicit ``runs`` entry
+    (init_pool_type varies per strategy, so the grid form cannot
+    express the paper's protocol).  ``sweep`` narrows to one grid;
+    default is all 38 experiments."""
+    names = [sweep] if sweep else list(SWEEPS)
+    for name in names:
+        if name not in SWEEPS:
+            raise ValueError(f"unknown sweep {name!r} "
+                             f"(one of: {', '.join(SWEEPS)})")
+    runs = []
+    for name in names:
+        for args in SWEEPS[name](dataset_dir):
+            rest = dict(args)
+            rest.pop("dataset_dir", None)
+            runs.append(rest)
+    return {"name": sweep or "paper_sweeps",
+            "defaults": {"dataset_dir": dataset_dir},
+            "runs": runs}
+
+
+def get_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m active_learning_tpu.experiment.gen_jobs",
+        description="Print the paper's experiment sweeps as shell "
+                    "commands or a fleet sweep-spec JSON")
+    p.add_argument("dataset_dir", nargs="?",
+                   default="<YOUR DATASET DIR HERE>")
+    p.add_argument("--format", choices=["shell", "fleet"],
+                   default="shell", dest="fmt")
+    p.add_argument("--sweep", choices=sorted(SWEEPS), default=None,
+                   help="narrow --format fleet to one grid "
+                        "(default: all three sweeps, 38 runs)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = get_parser().parse_args(
+        sys.argv[1:] if argv is None else argv)
+    if args.fmt == "fleet":
+        print(json.dumps(fleet_spec(args.dataset_dir, args.sweep),
+                         indent=1))
+        return
+    for job in all_jobs(args.dataset_dir):
         print(job)
 
 

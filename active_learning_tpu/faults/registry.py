@@ -86,9 +86,14 @@ from typing import Any, Dict, Optional, Tuple
 #                 point between the block's two half-reads: a fault
 #                 there must never leave a partial block in the host
 #                 cache; the gather's RetryPolicy re-reads the block)
+#   fleet_journal fleet/journal.write_atomic_json — the fleet
+#                 controller's scheduling-state rewrite (torn point
+#                 between the tmp write and the rename: a controller
+#                 killed there must restart from the PREVIOUS complete
+#                 journal, never a spliced one)
 SITES = ("h2d_upload", "ckpt_write", "spec_scorer", "feed_worker",
          "shard_upload", "dispatch", "grad_probe", "wal_write",
-         "stream_drain", "page_read")
+         "stream_drain", "page_read", "fleet_journal")
 
 ACTIONS = ("raise", "oom", "die", "delay", "torn")
 

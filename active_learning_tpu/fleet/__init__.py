@@ -1,0 +1,46 @@
+"""The fleet layer: many experiments on preemptible capacity
+(DESIGN.md §17).
+
+PRs 4–16 built a complete preemption substrate — SIGTERM
+checkpoint-and-exit, bit-identical resume from a kill at any point, the
+atomic round journal, ``status --strict`` exit codes, per-run heartbeats
+and Prometheus scrape files — and until now nothing consumed it: the
+multi-experiment story was ``gen_jobs.py`` printing shell commands for a
+human to paste.  This package is the layer above, after Podracer's
+decoupled preemption-tolerant TPU actors:
+
+  * ``spec``        — a declarative JSON sweep (strategy × seed ×
+                      dataset × budget grids) expanded into run records
+                      with stable run-ids;
+  * ``journal``     — the atomic tmp+rename fleet journal (the
+                      faults/journal.py discipline, one level up) the
+                      controller restarts from;
+  * ``controller``  — packs queued runs onto registered workers,
+                      launches them through the existing CLI, polls
+                      health through heartbeats / ``status --strict`` /
+                      Prometheus scrape files, and reschedules preempted
+                      runs with ``--resume_training``;
+  * ``report``      — fleet-wide aggregation: every run's
+                      run_report.json through the matched-budget
+                      cross-run machinery (telemetry/report.py) plus a
+                      merged fleet Prometheus scrape file;
+  * ``cli``         — the ``fleet`` verb (``fleet run / status /
+                      report``).
+
+Host-pure BY CONSTRUCTION: no module in this package may import jax —
+the controller runs on a CPU-only head node against workers it can never
+share a backend with.  al_lint check 18 (``fleet-host-pure``) enforces
+it statically, alongside the rule that every fleet-journal write goes
+through the one atomic tmp+rename helper (``journal.write_atomic_json``).
+Every fleet module declares ``_FLEET_MODULE = True`` — the closed
+registry that same check audits for coverage.
+"""
+
+_FLEET_MODULE = True
+
+from .controller import (FLEET_PROM_FILE, FleetController,  # noqa: F401
+                         Worker, default_base_cmd, has_saved_experiment)
+from .journal import (FLEET_JOURNAL_FILE, FleetJournal,  # noqa: F401
+                      read_fleet_journal, write_atomic_json)
+from .spec import (expand_spec, load_spec, run_argv,  # noqa: F401
+                   run_id_for)

@@ -1,0 +1,124 @@
+"""The ``fleet`` CLI verb: run / status / report.
+
+    python -m active_learning_tpu fleet run --spec sweep.json \
+        --fleet_dir ./fleet --workers w0,w1
+    python -m active_learning_tpu fleet status --fleet_dir ./fleet
+    python -m active_learning_tpu fleet report --fleet_dir ./fleet
+
+``run`` drives a sweep to completion on localhost workers (or, with
+``--dry_run``, prints the per-run commands for a real cluster's
+launcher and exits — the controller never pretends to own remote
+placement).  ``status`` is the lifecycle table from the fleet journal;
+``report`` adds the matched-budget strategy comparison and rewrites the
+merged fleet scrape file.  Host-pure like the rest of the package: the
+head node never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import List, Optional
+
+from . import report as fleet_report
+from .controller import FleetController, Worker, default_base_cmd
+from .spec import load_spec
+
+_FLEET_MODULE = True
+
+
+def get_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m active_learning_tpu fleet",
+        description="Run, inspect, and report a fleet of experiments")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    run = sub.add_parser(
+        "run", help="drive a sweep spec to completion on local workers")
+    run.add_argument("--spec", type=str, required=True,
+                     help="sweep-spec JSON (gen_jobs --format fleet "
+                          "emits the paper's grids in this shape)")
+    run.add_argument("--fleet_dir", type=str, required=True,
+                     help="fleet state root: journal, per-run dirs, "
+                          "scrape files")
+    run.add_argument("--workers", type=str, default="w0",
+                     help="comma-separated worker names; name=N sets "
+                          "slots (default 1), e.g. 'w0=2,w1'")
+    run.add_argument("--max_attempts", type=int, default=3,
+                     help="launches per run before it parks as failed")
+    run.add_argument("--poll_every_s", type=float, default=1.0)
+    run.add_argument("--dry_run", action="store_true",
+                     help="print the per-run commands and exit without "
+                          "launching (cluster-launcher mode)")
+    run.add_argument("--base_cmd", type=str, default=None,
+                     help="launcher prefix replacing 'python -m "
+                          "active_learning_tpu' (shlex-split) — wrapper "
+                          "scripts, srun/ssh shims, test harnesses")
+
+    for verb, help_ in (("status", "lifecycle table from the journal"),
+                        ("report", "fleet table + matched-budget "
+                                   "comparison + merged scrape file")):
+        sp = sub.add_parser(verb, help=help_)
+        sp.add_argument("--fleet_dir", type=str, required=True)
+        sp.add_argument("--json", action="store_true", dest="as_json")
+    return p
+
+
+def parse_workers(text: str) -> List[Worker]:
+    workers = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, slots = part.partition("=")
+        workers.append(Worker(name, int(slots) if slots else 1))
+    return workers
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = get_parser().parse_args(argv)
+    if args.verb == "run":
+        import shlex
+        spec = load_spec(args.spec)
+        base_cmd = (shlex.split(args.base_cmd) if args.base_cmd
+                    else default_base_cmd())
+        controller = FleetController(
+            args.fleet_dir, spec, parse_workers(args.workers),
+            base_cmd=base_cmd,
+            max_attempts=args.max_attempts,
+            poll_every_s=args.poll_every_s, dry_run=args.dry_run)
+        if args.dry_run:
+            for cmd in controller.schedule_once():
+                print(" ".join(cmd))
+            return 0
+        controller.install_signal_handlers()
+        counts = controller.run()
+        print("fleet run: " + "  ".join(
+            f"{state}={n}" for state, n in sorted(counts.items())))
+        # Non-zero only when a run EXHAUSTED its attempts; a clean
+        # controller preemption (SIGTERM mid-schedule) exits 0 like a
+        # preempted run does — the next life resumes from the journal.
+        return 1 if counts.get("failed") else 0
+    payload = fleet_report.fleet_payload(args.fleet_dir)
+    if args.verb == "report":
+        fleet_report.merge_prom(args.fleet_dir)
+    if args.as_json:
+        print(fleet_report.as_json(payload))
+        return 0
+    if args.verb == "status":
+        public = {k: v for k, v in payload.items()
+                  if k in ("spec_name", "controller", "counts",
+                           "resumes_total", "preemptions_total")}
+        print(f"fleet status: {args.fleet_dir}")
+        print(json.dumps(public, indent=1))
+        for rec in payload["runs"]:
+            print(f"  {rec.get('run_id')}: {rec.get('state')} "
+                  f"worker={rec.get('worker')} "
+                  f"round={rec.get('round')} health={rec.get('health')}")
+        return 0
+    print(fleet_report.render_fleet(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

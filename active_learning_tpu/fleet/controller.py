@@ -1,0 +1,457 @@
+"""The fleet scheduler: N experiments packed onto preemptible workers.
+
+The controller owns one fleet directory.  It expands a sweep spec into
+run records (``spec.py``), persists every lifecycle transition in the
+atomic fleet journal (``journal.py``), and packs queued runs onto
+registered workers by free capacity — launching each through the
+EXISTING CLI (``python -m active_learning_tpu`` as a localhost
+subprocess; dry-run mode emits the commands for a real cluster's
+launcher instead).  Health comes from the substrate PRs 4–16 built and
+nothing previously consumed:
+
+  * heartbeat files for liveness (mtime vs the embedded deadline);
+  * ``status --strict`` exit codes — via ``status.strict_exit_code`` on
+    the SAME summarize() the CLI uses, so controller and shell can
+    never disagree about a run's health;
+  * the per-run Prometheus scrape file for progress (rounds completed,
+    fault_retries_total, degrade_events).
+
+Failure modes, each named and tested (tests/test_fleet.py):
+
+  * **worker dies / SIGKILL mid-round** — the child's exit code is
+    non-zero; the run re-queues with ``--resume_training`` (when a saved
+    experiment exists) up to ``max_attempts``, then parks as ``failed``;
+  * **clean preemption (SIGTERM)** — the child checkpoints and exits 0
+    with the round journal saying ``status=preempted``; the run
+    re-queues for resume on the next free worker.  The bit-identical-
+    resume contract (tests/test_faults.py) makes the fleet result
+    provably identical to an unpreempted run;
+  * **controller dies and restarts** — the fleet journal replays: runs
+    whose pid is still alive with a fresh heartbeat are ADOPTED (polled
+    to completion, never relaunched); dead ones re-queue for resume;
+    finished ones stay finished;
+  * **run degrades** — ``strict_exit_code`` 4 is recorded in the run's
+    journal record and counted in the fleet gauges; the run keeps its
+    worker (a self-healing run is progress, not a failure);
+  * **run wedges (stale heartbeat)** — exit code 3: the child is killed
+    and the run re-queues like any other preemption.
+
+Host-pure: no jax import anywhere in this package (al_lint check 18) —
+this process runs on a CPU-only head node that could never initialize a
+worker's accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ..faults.journal import JOURNAL_FILE, read_journal
+from ..telemetry import prom
+from ..telemetry.status import strict_exit_code, summarize
+from .journal import FLEET_JOURNAL_FILE, FleetJournal
+from .spec import expand_spec, run_argv
+
+_FLEET_MODULE = True
+
+# The saved-experiment marker files (experiment/resume.py spells these;
+# redeclared here because importing experiment/ would drag jax onto the
+# head node — tests pin the two spellings against resume.py's).
+_STATE_FILE = "experiment_state.npz"
+_META_FILE = "experiment_state.json"
+
+FLEET_PROM_FILE = "fleet.prom"
+
+# Run lifecycle states as journaled.  "preempted"/"stalled" are
+# transitions, not states: the controller re-queues in the same poll, so
+# the journal only ever shows queued/running/finished/failed.
+RUN_STATES = ("queued", "running", "finished", "failed")
+
+# Lock discipline: the controller is single-threaded by design (one
+# poll loop; signals only set flags), so there is no _GUARDED_BY
+# registry here — concurrency lives in the child processes.
+
+
+def default_base_cmd() -> List[str]:
+    return [sys.executable, "-m", "active_learning_tpu"]
+
+
+def has_saved_experiment(ckpt_path: str, exp_name: str,
+                         exp_hash: str) -> bool:
+    """True when a resumable experiment state exists — the same
+    two-file test experiment/resume.py applies, without the jax
+    import."""
+    state_dir = os.path.join(ckpt_path, f"{exp_name}_{exp_hash}")
+    return (os.path.exists(os.path.join(state_dir, _STATE_FILE))
+            and os.path.exists(os.path.join(state_dir, _META_FILE)))
+
+
+class Worker:
+    """One unit of capacity: a named slot group the scheduler packs runs
+    onto.  On localhost every worker is this process's subprocess pool;
+    ``env`` overlays the child environment (CI pins JAX_PLATFORMS=cpu
+    here).  For a real cluster, dry-run mode emits the per-worker
+    commands and an external launcher owns placement."""
+
+    def __init__(self, name: str, slots: int = 1,
+                 env: Optional[Dict[str, str]] = None):
+        if slots < 1:
+            raise ValueError(f"worker {name!r} needs at least one slot")
+        self.name = name
+        self.slots = slots
+        self.env = dict(env or {})
+
+
+class _Child:
+    """A launched run: a real subprocess, or an ADOPTED pid from a
+    previous controller life (same poll surface, no wait() rights)."""
+
+    def __init__(self, pid: int, proc: Optional[subprocess.Popen] = None):
+        self.pid = pid
+        self.proc = proc
+
+    def poll(self) -> Optional[int]:
+        if self.proc is not None:
+            return self.proc.poll()
+        # Adopted: not our child, so no exit status — pid liveness is
+        # the only signal, and the round journal supplies the verdict.
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except OSError:
+            return -1
+
+    def adopted(self) -> bool:
+        return self.proc is None
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+
+class FleetController:
+    """The scheduler.  ``schedule_once()`` is one poll: reap finished
+    children, judge health, re-queue preemptions, pack free slots.
+    ``run()`` loops it until every run is terminal (or ``stop()`` /
+    SIGTERM asks for a clean handoff)."""
+
+    def __init__(self, fleet_dir: str, spec: Dict[str, Any],
+                 workers: List[Worker],
+                 base_cmd: Optional[List[str]] = None,
+                 max_attempts: int = 3, poll_every_s: float = 1.0,
+                 dry_run: bool = False):
+        self.fleet_dir = fleet_dir
+        self.spec = spec
+        self.workers = list(workers)
+        if not self.workers and not dry_run:
+            raise ValueError("a live fleet needs at least one worker")
+        self.base_cmd = list(base_cmd or default_base_cmd())
+        self.max_attempts = max_attempts
+        self.poll_every_s = poll_every_s
+        self.dry_run = dry_run
+        self.journal = FleetJournal(
+            os.path.join(fleet_dir, FLEET_JOURNAL_FILE))
+        self._children: Dict[str, _Child] = {}
+        self._stop_requested = False
+        # Expand the spec, then replay the journal over it: run-ids are
+        # stable (spec.run_id_for), so a restarted controller re-attaches
+        # every lifecycle record to its run.
+        self.runs: Dict[str, Dict[str, Any]] = {}
+        for rec in expand_spec(spec):
+            self.runs[rec["run_id"]] = {
+                "run_id": rec["run_id"], "args": rec["args"],
+                "state": "queued", "worker": None, "pid": None,
+                "attempts": 0, "resumes": 0, "preemptions": 0,
+                "health": None, "rc": None, "resume": False,
+            }
+        self._recover()
+
+    # -- directories / commands -------------------------------------------
+
+    def run_dir(self, run_id: str) -> str:
+        return os.path.join(self.fleet_dir, "runs", run_id)
+
+    def log_dir(self, run_id: str) -> str:
+        return os.path.join(self.run_dir(run_id), "logs")
+
+    def ckpt_dir(self, run_id: str) -> str:
+        return os.path.join(self.run_dir(run_id), "ckpt")
+
+    def prom_file(self, run_id: str) -> str:
+        return os.path.join(self.run_dir(run_id), "run.prom")
+
+    def command_for(self, run_id: str, resume: bool = False) -> List[str]:
+        """The full launch argv for a run.  Controller-owned flags come
+        AFTER the spec's (argparse last-wins), so the fleet layout —
+        per-run log/ckpt dirs, deterministic exp identity, the scrape
+        file — cannot be silently redirected by a spec entry."""
+        run = self.runs[run_id]
+        argv = self.base_cmd + run_argv(run["args"])
+        argv += ["--exp_name", run["args"].get("exp_name", run_id),
+                 "--exp_hash", "fleet",
+                 "--log_dir", self.log_dir(run_id),
+                 "--ckpt_path", self.ckpt_dir(run_id),
+                 "--prometheus_file", self.prom_file(run_id)]
+        if resume:
+            argv.append("--resume_training")
+        return argv
+
+    def _can_resume(self, run_id: str) -> bool:
+        run = self.runs[run_id]
+        return has_saved_experiment(
+            self.ckpt_dir(run_id),
+            run["args"].get("exp_name", run_id), "fleet")
+
+    # -- journal ----------------------------------------------------------
+
+    def _journal_write(self, **extra: Any) -> None:
+        snapshot = {
+            rid: {k: run[k] for k in
+                  ("state", "worker", "pid", "attempts", "resumes",
+                   "preemptions", "health", "rc", "resume")}
+            for rid, run in self.runs.items()}
+        self.journal.write(
+            spec_name=self.spec.get("name"), runs=snapshot,
+            controller={"pid": os.getpid(),
+                        "status": extra.pop("controller_status",
+                                            "running")},
+            **extra)
+
+    def _recover(self) -> None:
+        """Replay a previous controller life from the fleet journal:
+        finished/failed records stick; a 'running' record whose pid is
+        still alive with a non-stale heartbeat is ADOPTED; everything
+        else re-queues (with resume when a saved experiment exists)."""
+        from .journal import read_fleet_journal
+        prior = read_fleet_journal(self.journal.path)
+        if not prior:
+            return
+        for rid, old in (prior.get("runs") or {}).items():
+            run = self.runs.get(rid)
+            if run is None:
+                continue  # the spec shrank; the journal keeps history
+            run.update({k: old.get(k, run[k]) for k in
+                        ("state", "worker", "pid", "attempts", "resumes",
+                         "preemptions", "health", "rc", "resume")})
+            if run["state"] == "running":
+                child = _Child(run["pid"]) if run["pid"] else None
+                if child is not None and child.poll() is None:
+                    # Alive: ADOPT, never relaunch — a second process
+                    # on the same ckpt dir would corrupt the run.  If
+                    # it later proves wedged, the stale-heartbeat path
+                    # kills and re-queues it like any other preemption.
+                    self._children[rid] = child
+                else:
+                    self._requeue(rid, why="controller-restart")
+
+    # -- scheduling -------------------------------------------------------
+
+    def _requeue(self, run_id: str, why: str) -> None:
+        run = self.runs[run_id]
+        run["state"] = "queued"
+        run["worker"] = None
+        run["pid"] = None
+        run["resume"] = self._can_resume(run_id)
+        if why in ("preempted", "stalled"):
+            run["preemptions"] += 1
+        if run["resume"]:
+            run["resumes"] += 1
+
+    def _free_slots(self) -> List[Worker]:
+        """Workers with spare capacity, one entry per free slot, in
+        registration order — the packing is deterministic."""
+        used: Dict[str, int] = {}
+        for run in self.runs.values():
+            if run["state"] == "running" and run["worker"]:
+                used[run["worker"]] = used.get(run["worker"], 0) + 1
+        slots = []
+        for w in self.workers:
+            for _ in range(w.slots - used.get(w.name, 0)):
+                slots.append(w)
+        return slots
+
+    def _launch(self, run_id: str, worker: Worker) -> None:
+        run = self.runs[run_id]
+        resume = run["resume"] and self._can_resume(run_id)
+        argv = self.command_for(run_id, resume=resume)
+        os.makedirs(self.log_dir(run_id), exist_ok=True)
+        os.makedirs(self.ckpt_dir(run_id), exist_ok=True)
+        env = {**os.environ, **worker.env}
+        out = open(os.path.join(self.run_dir(run_id), "child.log"), "ab")
+        try:
+            proc = subprocess.Popen(argv, stdout=out, stderr=out, env=env)
+        finally:
+            out.close()
+        run.update(state="running", worker=worker.name, pid=proc.pid,
+                   rc=None)
+        run["attempts"] += 1
+        self._children[run_id] = _Child(proc.pid, proc)
+
+    def _reap(self, run_id: str, rc: int) -> None:
+        """A child ended: the round journal — not the exit code alone —
+        says what happened.  Clean preemption exits 0 with
+        status=preempted; only status=finished (or no telemetry at all)
+        with rc 0 counts as done."""
+        run = self.runs[run_id]
+        self._children.pop(run_id, None)
+        run["rc"] = rc
+        journal = read_journal(
+            os.path.join(self.log_dir(run_id), JOURNAL_FILE)) or {}
+        status = journal.get("status")
+        if rc == 0 and status == "preempted":
+            self._requeue(run_id, why="preempted")
+        elif rc == 0:
+            run.update(state="finished", worker=None, pid=None)
+        elif run["attempts"] >= self.max_attempts:
+            run.update(state="failed", worker=None, pid=None)
+        else:
+            self._requeue(run_id, why="died")
+
+    def _poll_health(self, run_id: str) -> None:
+        """Judge a running run through the status contract; a stale
+        heartbeat (3) means the child wedged — kill it and let the reap
+        path re-queue.  Degraded (4) is recorded, not acted on."""
+        run = self.runs[run_id]
+        run["health"] = strict_exit_code(summarize(self.log_dir(run_id)))
+        if run["health"] == 3:
+            child = self._children.get(run_id)
+            if child is not None:
+                child.kill()
+
+    def progress_of(self, run_id: str) -> Dict[str, float]:
+        """Rounds completed / fault retries / degrade events from the
+        run's Prometheus scrape file — the third leg of the substrate,
+        consumed as data."""
+        try:
+            with open(self.prom_file(run_id)) as fh:
+                gauges = prom.parse(fh.read())
+        except (OSError, ValueError):
+            return {}
+        out = {}
+        for short, name in (("round", "al_run_round"),
+                            ("fault_retries", "al_run_fault_retries_total"),
+                            ("degrade_events", "al_run_degrade_events")):
+            series = gauges.get(name)
+            if series:
+                out[short] = next(iter(series.values()))
+        return out
+
+    def counts(self) -> Dict[str, int]:
+        c = {state: 0 for state in RUN_STATES}
+        for run in self.runs.values():
+            c[run["state"]] += 1
+        return c
+
+    def _write_fleet_prom(self) -> None:
+        counts = self.counts()
+        gauges: Dict[str, Any] = {
+            f"runs_{state}": n for state, n in counts.items()}
+        gauges["resumes_total"] = sum(
+            r["resumes"] for r in self.runs.values())
+        gauges["preemptions_total"] = sum(
+            r["preemptions"] for r in self.runs.values())
+        gauges["runs_degraded"] = sum(
+            1 for r in self.runs.values()
+            if r["state"] == "running" and r["health"] == 4)
+        prom.write_textfile(
+            os.path.join(self.fleet_dir, FLEET_PROM_FILE),
+            prom.render(prom.gauge_samples(gauges, prefix="al_fleet_")))
+
+    def schedule_once(self) -> List[List[str]]:
+        """One scheduler poll.  Returns the commands launched this poll
+        (in dry-run mode: the commands that WOULD launch, with the runs
+        left queued — the cluster's own launcher owns them)."""
+        # 1. Reap ended children.
+        for rid in list(self._children):
+            child = self._children[rid]
+            rc = child.poll()
+            if rc is not None:
+                if child.adopted():
+                    # No wait() rights on an adopted pid: the round
+                    # journal is the only verdict.  finished → rc 0;
+                    # anything else re-queues like a death.
+                    journal = read_journal(os.path.join(
+                        self.log_dir(rid), JOURNAL_FILE)) or {}
+                    rc = 0 if journal.get("status") in ("finished",
+                                                        "preempted") \
+                        else 1
+                self._reap(rid, rc)
+        # 2. Health-check the survivors.
+        for rid, run in self.runs.items():
+            if run["state"] == "running" and rid in self._children:
+                self._poll_health(rid)
+        # 3. Pack queued runs onto free slots.
+        launched: List[List[str]] = []
+        queued = [rid for rid, run in sorted(self.runs.items())
+                  if run["state"] == "queued"]
+        if self.dry_run:
+            launched = [self.command_for(rid, resume=self.runs[rid]
+                        ["resume"] and self._can_resume(rid))
+                        for rid in queued]
+        else:
+            for rid, worker in zip(queued, self._free_slots()):
+                self._launch(rid, worker)
+                launched.append(self.command_for(rid))
+        self._journal_write()
+        self._write_fleet_prom()
+        return launched
+
+    def done(self) -> bool:
+        return all(run["state"] in ("finished", "failed")
+                   for run in self.runs.values())
+
+    def stop(self) -> None:
+        self._stop_requested = True
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → clean handoff: stop scheduling, SIGTERM the
+        children (they checkpoint-and-exit via their own handlers),
+        journal ``controller=preempted``, return.  The next controller
+        restarts from the journal and re-queues every unfinished run."""
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: self.stop())
+
+    def run(self) -> Dict[str, int]:
+        """Schedule until every run is terminal (or stop() is called).
+        Returns the final state counts."""
+        while True:
+            self.schedule_once()
+            if self.dry_run or self.done() or self._stop_requested:
+                break
+            time.sleep(self.poll_every_s)
+        if self._stop_requested and not self.done():
+            self._handoff()
+        else:
+            self._journal_write(
+                controller_status="finished" if self.done() else "running")
+        return self.counts()
+
+    def _handoff(self) -> None:
+        """The controller's own preemption: evict the children cleanly
+        and journal the interrupted fleet for the next life."""
+        for child in self._children.values():
+            child.terminate()
+        deadline = time.time() + 30.0
+        for rid in list(self._children):
+            child = self._children[rid]
+            while child.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            rc = child.poll()
+            if rc is None:
+                child.kill()
+                rc = -9
+            self._reap(rid, rc)
+        self._journal_write(controller_status="preempted")
+        self._write_fleet_prom()

@@ -1,0 +1,106 @@
+"""The atomic fleet journal: one JSON file recording where the FLEET is.
+
+``fleet_journal.json`` lives in the fleet directory and carries the
+controller's whole scheduling state — every run's lifecycle record
+(state, worker, pid, attempts, resumes, progress), the controller's own
+status, and a monotonic ``seq`` — rewritten atomically (tmp + rename,
+the faults/journal.py discipline one level up) so a controller killed at
+ANY point restarts from a complete, ordered record: a torn write leaves
+the PREVIOUS complete journal on disk, never a spliced one.
+
+``write_atomic_json`` is the ONE write path: al_lint check 18
+(``fleet-host-pure``) statically forbids any other ``json.dump`` in the
+fleet package, so a journal write that could tear cannot land.  The
+``fleet_journal`` fault site sits inside it — enter point before the
+tmp write, torn point between the tmp write and the rename — so the
+chaos tests can MAKE the torn write happen and assert the reader sees
+only complete payloads (tests/test_fleet.py).
+
+Stdlib-only, like everything in this package: the journal must be
+readable and writable from a CPU-only head node.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .. import faults
+
+_FLEET_MODULE = True
+
+FLEET_JOURNAL_FILE = "fleet_journal.json"
+
+# Lock discipline, statically enforced (al_lint lock-discipline): the
+# merged field dict and seq are mutated from the scheduler loop AND the
+# signal-driven shutdown path — only under _lock.
+_GUARDED_BY = {"_fields": "_lock", "_seq": "_lock"}
+
+
+def write_atomic_json(path: str, payload: Dict[str, Any]) -> bool:
+    """THE fleet-package JSON write: tmp + fsync-free rename (the
+    publish_best idiom).  A crash before the rename leaves the previous
+    complete file; a crash after is the new complete file.  Returns
+    False instead of raising — a full disk must not take the controller
+    down (the run children own the real progress)."""
+    faults.site("fleet_journal")
+    try:
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        # Torn point: a kill here leaves the complete tmp beside the
+        # complete OLD journal — the reader never sees half a write.
+        faults.site("fleet_journal", point="torn")
+        os.replace(tmp, path)
+    except OSError:
+        return False
+    return True
+
+
+def read_fleet_journal(path: str) -> Optional[Dict[str, Any]]:
+    """The journal payload, or None when absent/unparseable (a torn file
+    is impossible by construction; missing means no controller ever ran
+    in this fleet directory)."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class FleetJournal:
+    """Merge-and-rewrite journal writer (the RoundJournal field
+    semantics: a write merges its fields over the retained ones, None
+    deletes).  Continues the ``seq`` of an existing file so two records
+    can always be ordered across controller restarts — the monotonic tag
+    never restarts within a fleet directory."""
+
+    def __init__(self, path: str, enabled: bool = True):
+        self.path = path
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._fields: Dict[str, Any] = {}
+        prior = read_fleet_journal(path) if enabled else None
+        self._seq = int(prior.get("seq", 0)) if prior else 0
+
+    def write(self, **fields: Any) -> Optional[Dict[str, Any]]:
+        """Merge ``fields`` (None values delete), bump seq, rewrite
+        atomically through ``write_atomic_json``.  Returns the written
+        payload (None when disabled or the write failed)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            for k, v in fields.items():
+                if v is None:
+                    self._fields.pop(k, None)
+                else:
+                    self._fields[k] = v
+            self._seq += 1
+            payload = {**self._fields, "seq": self._seq,
+                       "ts": time.time()}
+        return payload if write_atomic_json(self.path, payload) else None

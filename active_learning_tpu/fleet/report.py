@@ -1,0 +1,162 @@
+"""Fleet-wide reporting: every run's artifacts folded into one view.
+
+Three outputs from one fleet directory:
+
+  * the **fleet table** — per-run lifecycle (state, worker, attempts,
+    resumes, health) from the fleet journal, plus rounds-completed and
+    fault counters from each run's Prometheus scrape file;
+  * the **matched-budget strategy comparison** — every finished run's
+    ``run_report.json`` through the cross-run machinery in
+    telemetry/report.py (PR 12), exactly the table ``report a b c``
+    would render by hand;
+  * the **merged fleet scrape file** — every run's ``al_run_*`` gauges
+    relabeled with ``run_id`` into one exposition text beside the
+    controller's own ``al_fleet_*`` gauges, so one node-exporter
+    textfile covers the whole fleet.
+
+Stdlib-only (host-pure), same contract as the status/report verbs: this
+answers from any shell against a fleet directory, live or dead.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..telemetry import prom
+from ..telemetry.report import compare_payload, load_run, render_compare
+from .journal import FLEET_JOURNAL_FILE, read_fleet_journal
+
+_FLEET_MODULE = True
+
+MERGED_PROM_FILE = "fleet_runs.prom"
+
+
+def fleet_runs(fleet_dir: str) -> List[str]:
+    """The fleet's run-ids: the journal's record when present (ordering
+    and history), else the runs/ directory listing (a journal lost to a
+    dead disk must not hide the artifacts)."""
+    journal = read_fleet_journal(
+        os.path.join(fleet_dir, FLEET_JOURNAL_FILE))
+    if journal and journal.get("runs"):
+        return sorted(journal["runs"])
+    return sorted(os.path.basename(d) for d in
+                  glob.glob(os.path.join(fleet_dir, "runs", "*"))
+                  if os.path.isdir(d))
+
+
+def _run_progress(fleet_dir: str, run_id: str) -> Dict[str, Any]:
+    """Rounds / fault retries / degrade events from the run's scrape
+    file; empty when the run never wrote one."""
+    path = os.path.join(fleet_dir, "runs", run_id, "run.prom")
+    try:
+        with open(path) as fh:
+            gauges = prom.parse(fh.read())
+    except (OSError, ValueError):
+        return {}
+    out: Dict[str, Any] = {}
+    for short, name in (("round", "al_run_round"),
+                        ("fault_retries", "al_run_fault_retries_total"),
+                        ("degrade_events", "al_run_degrade_events")):
+        series = gauges.get(name)
+        if series:
+            out[short] = next(iter(series.values()))
+    return out
+
+
+def fleet_payload(fleet_dir: str) -> Dict[str, Any]:
+    """The machine-readable fleet report: journal lifecycle + per-run
+    progress + the matched-budget comparison payload over every run
+    with a report artifact."""
+    journal = read_fleet_journal(
+        os.path.join(fleet_dir, FLEET_JOURNAL_FILE)) or {}
+    records = journal.get("runs") or {}
+    rows = []
+    reports = []
+    for run_id in fleet_runs(fleet_dir):
+        rec = dict(records.get(run_id) or {})
+        rec["run_id"] = run_id
+        rec.update(_run_progress(fleet_dir, run_id))
+        rows.append(rec)
+        run = load_run(os.path.join(fleet_dir, "runs", run_id, "logs"))
+        if run is not None:
+            run.setdefault("exp_name", run_id)
+            reports.append(run)
+    counts: Dict[str, int] = {}
+    for rec in rows:
+        state = rec.get("state") or "unknown"
+        counts[state] = counts.get(state, 0) + 1
+    return {"fleet_dir": fleet_dir,
+            "spec_name": journal.get("spec_name"),
+            "controller": journal.get("controller"),
+            "seq": journal.get("seq"),
+            "counts": counts,
+            "resumes_total": sum(int(r.get("resumes") or 0)
+                                 for r in rows),
+            "preemptions_total": sum(int(r.get("preemptions") or 0)
+                                     for r in rows),
+            "runs": rows,
+            "comparison": compare_payload(reports) if reports else None,
+            "_reports": reports}
+
+
+def render_fleet(payload: Dict[str, Any]) -> str:
+    """The human fleet report: lifecycle table, then the matched-budget
+    strategy comparison over every run that produced a report."""
+    counts = payload["counts"]
+    head = (f"fleet report: {payload.get('spec_name') or 'sweep'}  "
+            f"({payload['fleet_dir']})\n"
+            f"  runs: " + "  ".join(
+                f"{state}={n}" for state, n in sorted(counts.items()))
+            + f"  resumes={payload['resumes_total']}"
+              f"  preemptions={payload['preemptions_total']}")
+    headers = ["run_id", "state", "worker", "round", "attempts",
+               "resumes", "health", "retries", "degrades"]
+    lines = [head, "  ".join(headers)]
+    for rec in payload["runs"]:
+        cells = [rec.get("run_id"), rec.get("state"),
+                 rec.get("worker"), rec.get("round"),
+                 rec.get("attempts"), rec.get("resumes"),
+                 rec.get("health"), rec.get("fault_retries"),
+                 rec.get("degrade_events")]
+        lines.append("  ".join(
+            "-" if c is None else str(c) for c in cells))
+    reports = payload.get("_reports") or []
+    if reports:
+        lines.append("")
+        lines.append(render_compare(reports))
+    else:
+        lines.append("  (no run produced a run_report.json yet)")
+    return "\n".join(lines)
+
+
+def merge_prom(fleet_dir: str,
+               out_file: Optional[str] = None) -> Tuple[str, int]:
+    """Every run's scrape file merged into one exposition text: each
+    ``al_run_*`` sample relabeled with ``run_id`` (existing labels
+    kept), written atomically to ``fleet_runs.prom``.  Returns (path,
+    runs merged)."""
+    samples: List[prom.Sample] = []
+    merged = 0
+    for run_id in fleet_runs(fleet_dir):
+        path = os.path.join(fleet_dir, "runs", run_id, "run.prom")
+        try:
+            with open(path) as fh:
+                gauges = prom.parse(fh.read())
+        except (OSError, ValueError):
+            continue
+        merged += 1
+        for name, series in gauges.items():
+            for labels, value in series.items():
+                samples.append(
+                    (name, {**dict(labels), "run_id": run_id}, value))
+    out = out_file or os.path.join(fleet_dir, MERGED_PROM_FILE)
+    prom.write_textfile(out, prom.render(samples))
+    return out, merged
+
+
+def as_json(payload: Dict[str, Any]) -> str:
+    public = {k: v for k, v in payload.items() if not k.startswith("_")}
+    return json.dumps(public, indent=1)

@@ -1,0 +1,142 @@
+"""Sweep specs: the declarative form of a fleet of experiments.
+
+A sweep spec is one JSON object describing N runs — ``gen_jobs.py``
+reborn as a programmatic producer (``gen_jobs --format fleet`` emits the
+paper's three grids in exactly this shape):
+
+    {
+      "name": "cifar10_paper",
+      "defaults": {"dataset": "cifar10", "n_epoch": 200, ...},
+      "grid":     {"strategy": ["MarginSampler", "RandomSampler"],
+                   "run_seed": [0, 1]},
+      "runs":     [{"strategy": "BADGESampler", "partitions": 10}]
+    }
+
+``expand_spec`` turns that into run records: the cartesian product of
+the ``grid`` axes (in declaration order — JSON objects are ordered) plus
+every explicit ``runs`` entry, each merged over ``defaults`` and stamped
+with a STABLE run-id.  Stability is the contract the whole fleet layer
+leans on: the id is a readable slug plus a content hash of the full
+argument dict, so re-expanding the same spec after a controller restart
+reproduces the same ids and the journal's lifecycle records re-attach to
+their runs — and two specs that would launch an identical experiment
+collide loudly instead of silently double-running it.
+
+Arg dicts use CLI flag spellings without the dashes (``run_argv`` maps
+them back: ``True`` → bare ``--flag``, ``False``/``None`` dropped), so a
+spec round-trips through ``experiment/cli.get_parser`` — the controller
+launches exactly what a human would have pasted.
+
+Stdlib-only (host-pure): specs expand on a CPU-only head node.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+from typing import Any, Dict, List
+
+_FLEET_MODULE = True
+
+# Keys woven into the readable slug, in order, when present.
+_SLUG_KEYS = ("strategy", "dataset", "round_budget", "run_seed")
+
+# Keys a spec's top level may carry; anything else is a typo we refuse
+# to guess about (a misspelled "grid" would silently launch one run).
+_SPEC_KEYS = frozenset({"name", "defaults", "grid", "runs"})
+
+
+def load_spec(path: str) -> Dict[str, Any]:
+    """Read and validate a sweep-spec JSON file.  Raises ValueError on
+    structural problems — a bad spec must die at submit time, not after
+    half the fleet launched."""
+    with open(path) as fh:
+        spec = json.load(fh)
+    return validate_spec(spec)
+
+
+def validate_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
+    if not isinstance(spec, dict):
+        raise ValueError("sweep spec must be a JSON object")
+    unknown = set(spec) - _SPEC_KEYS
+    if unknown:
+        raise ValueError(
+            f"sweep spec has unknown top-level keys {sorted(unknown)} "
+            f"(allowed: {sorted(_SPEC_KEYS)})")
+    if not isinstance(spec.get("defaults", {}), dict):
+        raise ValueError("'defaults' must be an object of CLI args")
+    grid = spec.get("grid", {})
+    if not isinstance(grid, dict):
+        raise ValueError("'grid' must be an object of {axis: [values]}")
+    for axis, values in grid.items():
+        if not isinstance(values, list) or not values:
+            raise ValueError(
+                f"grid axis {axis!r} must be a non-empty list")
+    runs = spec.get("runs", [])
+    if not isinstance(runs, list) \
+            or any(not isinstance(r, dict) for r in runs):
+        raise ValueError("'runs' must be a list of arg objects")
+    if not grid and not runs:
+        raise ValueError("sweep spec expands to zero runs "
+                         "(empty 'grid' and 'runs')")
+    return spec
+
+
+def run_id_for(args: Dict[str, Any]) -> str:
+    """A stable, readable id for one run: slug of the distinguishing
+    args plus the first 8 hex chars of the sha1 of the FULL sorted arg
+    dict.  Same args → same id across processes, restarts, and spec
+    re-expansions; any differing arg → different id."""
+    digest = hashlib.sha1(
+        json.dumps(args, sort_keys=True, separators=(",", ":"),
+                   default=str).encode()).hexdigest()[:8]
+    slug = "-".join(str(args[k]) for k in _SLUG_KEYS if k in args)
+    return f"{slug}-{digest}" if slug else digest
+
+
+def expand_spec(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Expand a validated spec into run records
+    ``{"run_id", "args"}`` — grid product first (axes iterate in
+    declaration order, later axes fastest), then explicit ``runs``.
+    Raises ValueError when two records collapse to the same run-id:
+    identical args means an accidental double-launch of one experiment,
+    and the journal (keyed by run-id) could not tell them apart."""
+    validate_spec(spec)
+    defaults = dict(spec.get("defaults", {}))
+    records: List[Dict[str, Any]] = []
+    grid = spec.get("grid", {})
+    if grid:
+        axes = list(grid.keys())
+        for combo in itertools.product(*(grid[a] for a in axes)):
+            args = {**defaults, **dict(zip(axes, combo))}
+            records.append({"run_id": run_id_for(args), "args": args})
+    for extra in spec.get("runs", []):
+        args = {**defaults, **extra}
+        records.append({"run_id": run_id_for(args), "args": args})
+    seen: Dict[str, int] = {}
+    for i, rec in enumerate(records):
+        dup = seen.setdefault(rec["run_id"], i)
+        if dup != i:
+            raise ValueError(
+                f"runs {dup} and {i} expand to identical args "
+                f"(run_id {rec['run_id']}) — the sweep would launch "
+                "the same experiment twice")
+    return records
+
+
+def run_argv(args: Dict[str, Any]) -> List[str]:
+    """An arg dict as CLI tokens for ``python -m active_learning_tpu``:
+    ``{"strategy": "MarginSampler", "freeze_feature": True}`` →
+    ``["--strategy", "MarginSampler", "--freeze_feature"]``.  True means
+    a bare store_true flag; False/None mean absent (argparse defaults
+    apply); everything else is stringified."""
+    argv: List[str] = []
+    for key, value in args.items():
+        if value is None or value is False:
+            continue
+        if value is True:
+            argv.append(f"--{key}")
+        else:
+            argv.extend((f"--{key}", str(value)))
+    return argv

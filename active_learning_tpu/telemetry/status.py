@@ -297,30 +297,48 @@ def render_text(summary: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    args = get_parser().parse_args(argv)
-    summary = summarize(args.log_dir, stale_after=args.stale_after)
-    if args.as_json:
-        print(json.dumps(summary, indent=1))
-    else:
-        print(render_text(summary))
+def strict_exit_code(summary: Dict[str, Any]) -> int:
+    """The ``--strict`` orchestrator contract as a FUNCTION — the fleet
+    controller consumes status programmatically (fleet/controller.py)
+    through the same code path the CLI exits with, so the two can never
+    drift:
+
+      0 = healthy, 2 = no heartbeat, 3 = stale (staleness beats
+      degradation — no progress is the worse state), 4 = alive but
+      degraded-mode-active, 5 = ingest-starved (streaming only;
+      degradation beats it — a run on a rung is already a stronger
+      capacity signal).
+
+    Exit 4 lets orchestrators alert on capacity loss without killing a
+    self-healing run; exit 5 means the service is alive yet falling
+    behind its ingest."""
     if summary["state"] == "no-heartbeat":
         return 2
     if summary["state"] == "stale":
         return 3
-    if args.strict and summary.get("degraded"):
-        # Alive but running on a degradation-ladder rung: distinct from
-        # both healthy (0) and stale (3) so orchestrators can alert on
-        # capacity loss without killing a self-healing run.
+    if summary.get("degraded"):
         return 4
-    if args.strict and summary.get("ingest_starved"):
-        # Streaming only: rows keep being accepted (the WAL backlog is
-        # non-empty) but no round fired inside the deadline — the
-        # service is alive yet falling behind its ingest, which an
-        # orchestrator should scale or alert on (degradation beats it:
-        # a run on a rung is already a stronger capacity signal).
+    if summary.get("ingest_starved"):
         return 5
     return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = get_parser().parse_args(argv)
+    summary = summarize(args.log_dir, stale_after=args.stale_after)
+    code = strict_exit_code(summary)
+    if not args.strict and code in (4, 5):
+        # Degradation and ingest starvation are --strict refinements of
+        # "alive": the lax contract stays 0/2/3 exactly as published.
+        code = 0
+    if args.as_json:
+        # The machine payload carries the exit code it ships with, so a
+        # consumer parsing stdout never has to re-derive the contract
+        # (and a pipeline that lost the process status still has it).
+        print(json.dumps({**summary, "exit_code": code}, indent=1))
+    else:
+        print(render_text(summary))
+    return code
 
 
 if __name__ == "__main__":

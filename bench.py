@@ -182,6 +182,15 @@ PHASES = [
     # max_request_rows.  Records ingest rows/sec (WAL-fsync bound),
     # ack p50/p99, and the trigger cause.
     ("stream_round", 2, 64, 600),
+    # The fleet tier (DESIGN.md §17): a 2-run sweep on two localhost
+    # workers through the real controller, one child SIGKILL'd after
+    # its round-0 checkpoint — must resume and finish with the merged
+    # scrape + matched-budget comparison rendered.  iters is the
+    # per-run round count (floored at 2: the kill waits for a resumable
+    # checkpoint); per-chip batch is unused.  CPU-only (host-pure
+    # controller + the tests/fleet_child.py harness), so it never
+    # competes for the tunnel.
+    ("fleet_smoke", 2, 64, 900),
     # BASELINE.md metric #1: real end-to-end AL rounds through the
     # production driver.  iters is the per-round epoch count.
     ("al_round_cifar", 4, 128, 900),
@@ -205,7 +214,7 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # Hard bound on the ONE stdout line: the consuming harness records a
 # ~2,000-byte tail of stdout — which carries nothing but this line — so
 # the bound needs enough margin for tail-window slop, not another whole
-# line.  1950 fits the 14-phase realistic-maximal rich form (every
+# line.  1950 fits the 16-phase realistic-maximal rich form (every
 # phase cached with every optional
 # rider: the feed-hierarchy fields, unit/backend on BOTH paper-scale
 # selection phases, the sharded-ceiling probe's pool_sharding tag,
@@ -232,15 +241,21 @@ EVIDENCE_PATH = os.path.join(_STATE_DIR, "bench_evidence.json")
 # separators=(",", ":"); the default ", "/": " separators spent one
 # unbudgeted tail byte per key and comma (~150 bytes across the rich
 # form) until ISSUE 16's 15th phase pushed the spaced form past the
-# bound and exposed the gap — _compact_line now dumps compact.  15
-# phases ride; the measured realistic-maximal rich form is ~1780 bytes
+# bound and exposed the gap — _compact_line now dumps compact.  The
+# fleet tier (ISSUE 18) adds the 16th phase entry (~35 bytes) plus its
+# riders, worst case '"runs":N,"resumed":N,"wall_s":NNN.N,' ≈ 37 bytes
+# and its long unit string ('"unit":"runs finished/min (2-worker
+# localhost fleet)",' ≈ 52 bytes) — which pushed the 15-phase 1782-byte
+# maximal past 1950.  16 phases ride; the measured realistic-maximal
+# rich form is 1958 bytes
 # (pinned ≤ MAX_LINE_BYTES by test_compact_line_bounded_all_phases_full
-# with every phase's riders present), 1950 leaves ~50 bytes of
-# tail-window slop (the tail carries nothing but this line and its
-# newline), and the all-failed degraded form stays under the 1750-byte
-# tail-slop pin in tests/test_bench_json.py.  Pinned by unit tests at
-# both extremes.
-MAX_LINE_BYTES = 1950
+# with every phase's riders present AND a pytest-length evidence path —
+# ~44 bytes longer than the production ~/.cache path), 2000 leaves ~40
+# bytes of tail-window slop (the tail carries nothing but this line and
+# its newline), and the all-failed degraded form stays under the
+# 1750-byte tail-slop pin in tests/test_bench_json.py.  Pinned by unit
+# tests at both extremes.
+MAX_LINE_BYTES = 2000
 
 
 def log(msg: str) -> None:
@@ -1853,6 +1868,116 @@ def run_disk_pool_feed_phase(epochs: int) -> dict:
     }
 
 
+def run_fleet_smoke_phase(rounds: int) -> dict:
+    """The fleet tier end to end at bench scale (DESIGN.md §17): a
+    2-run sweep (Margin vs Random) on two localhost worker slots
+    through the REAL controller — spec expansion, journal, packing,
+    health polling, the CLI child launch path — with one child
+    SIGKILL'd after its round-0 checkpoint.  The controller must
+    re-queue it with ``--resume_training`` and the fleet must finish
+    with every run accounted; the phase records the resume/preemption
+    counters and the merged-scrape coverage as evidence.  The children
+    are the tests/fleet_child.py harness (the production driver behind
+    the production CLI flags, at TinyClassifier/synthetic-pool size) on
+    the CPU backend — the controller never touches an accelerator
+    (al_lint fleet-host-pure), so the scheduling claim is
+    backend-independent and this phase never competes for the tunnel."""
+    import shutil
+    import tempfile
+    import threading
+
+    from active_learning_tpu.fleet import (FLEET_JOURNAL_FILE,
+                                           FleetController, Worker,
+                                           read_fleet_journal)
+    from active_learning_tpu.fleet import report as fleet_report
+    from active_learning_tpu.telemetry import heartbeat as hb_lib
+
+    child = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tests", "fleet_child.py")
+    rounds = max(2, int(rounds))  # the kill waits for a round-0 ckpt
+    spec = {
+        "name": "bench_fleet_smoke",
+        "defaults": {
+            "dataset": "synthetic", "arg_pool": "synthetic",
+            "rounds": rounds, "round_budget": 8, "n_epoch": 3,
+            "early_stop_patience": 3, "round_pipeline": "speculative",
+            "heartbeat_every_s": 0.0, "run_seed": 0,
+        },
+        "grid": {"strategy": ["MarginSampler", "RandomSampler"]},
+    }
+    fleet_dir = tempfile.mkdtemp(prefix="al_bench_fleet_")
+    cpu_env = {"JAX_PLATFORMS": "cpu"}
+    ctrl = FleetController(
+        fleet_dir, spec,
+        [Worker("w0", env=cpu_env), Worker("w1", env=cpu_env)],
+        base_cmd=[sys.executable, child], poll_every_s=0.2)
+    log(f"[fleet_smoke] 2 runs x {rounds} rounds on 2 workers "
+        f"(children: {os.path.basename(child)})")
+    t0 = time.perf_counter()
+    thread = threading.Thread(target=ctrl.run, daemon=True)
+    thread.start()
+    # Preempt one worker the moment its run has a checkpoint to resume
+    # from: heartbeat round >= 1 means round 0 committed.
+    journal_path = os.path.join(fleet_dir, FLEET_JOURNAL_FILE)
+    killed = None
+    deadline = time.monotonic() + 420
+    while killed is None and thread.is_alive() \
+            and time.monotonic() < deadline:
+        journal = read_fleet_journal(journal_path) or {}
+        for rid, rec in (journal.get("runs") or {}).items():
+            if rec.get("state") != "running" or not rec.get("pid"):
+                continue
+            hb = hb_lib.read_heartbeat(os.path.join(
+                fleet_dir, "runs", rid, "logs", "heartbeat.json")) or {}
+            if (hb.get("round") or 0) >= 1 and hb.get("status") == "running":
+                try:
+                    os.kill(rec["pid"], signal.SIGKILL)
+                except OSError:
+                    continue
+                killed = rid
+                log(f"[fleet_smoke] SIGKILL'd {rid} (pid {rec['pid']}) "
+                    f"at round {hb.get('round')}")
+                break
+        time.sleep(0.05)
+    thread.join(timeout=480)
+    total_sec = time.perf_counter() - t0
+    if thread.is_alive():
+        ctrl.stop()
+        thread.join(timeout=60)
+        raise RuntimeError("fleet_smoke: controller never converged")
+    if killed is None:
+        raise RuntimeError("fleet_smoke: no run ever reached round 1 — "
+                           "the preemption was never injected")
+    counts = ctrl.counts()
+    resumes = sum(r["resumes"] for r in ctrl.runs.values())
+    attempts = sum(r["attempts"] for r in ctrl.runs.values())
+    if counts["finished"] != 2:
+        raise RuntimeError(f"fleet_smoke: fleet ended {counts}")
+    if resumes < 1:
+        raise RuntimeError("fleet_smoke: the SIGKILL'd run was not "
+                           "resumed from its checkpoint")
+    _, merged = fleet_report.merge_prom(fleet_dir)
+    payload = fleet_report.fleet_payload(fleet_dir)
+    shutil.rmtree(fleet_dir, ignore_errors=True)
+    return {
+        "phase": "fleet_smoke",
+        # Headline: fleet throughput (a scheduling rate, not a device
+        # rate — the controller is host-pure).
+        "ips": round(60.0 * counts["finished"] / total_sec, 2),
+        "ips_per_chip": round(60.0 * counts["finished"] / total_sec, 2),
+        "unit": "runs finished/min (2-worker localhost fleet)",
+        "runs_finished": counts["finished"],
+        "runs_failed": counts["failed"],
+        "runs_resumed": resumes,
+        "attempts_total": attempts,
+        "killed_run": killed,
+        "merged_prom_runs": merged,
+        "comparison_rendered": payload.get("comparison") is not None,
+        "total_sec": round(total_sec, 1),
+        "workers": 2,
+    }
+
+
 def _phase_setup(config: str, batch_size: int):
     """Shared model/trainer/batch construction for the timing child and
     the CPU FLOPs child: the batch schema and step signatures live in ONE
@@ -2190,6 +2315,9 @@ def run_child_phase(phase: str, iters: int, per_chip: int):
         return
     if phase == "disk_pool_feed":
         yield run_disk_pool_feed_phase(iters)
+        return
+    if phase == "fleet_smoke":
+        yield run_fleet_smoke_phase(iters)
         return
     config, kind = phase.rsplit("_", 1)
     n_chips = len(jax.devices())
@@ -2745,6 +2873,17 @@ def _compact_line(out: dict, evidence_ok: bool = True) -> str:
                          *((("cache_hit_frac", "hit"),
                             ("page_stall_ms_p99", "stall_ms"))
                            if name == "disk_pool_feed" else ()),
+                         # The fleet tier's riders (ISSUE 18): how many
+                         # runs finished, how many came back from a
+                         # preemption, and the fleet's wall — a
+                         # scheduling-rate headline is ambiguous
+                         # without them.  The rest (attempts, merged
+                         # scrape coverage, the killed run's id) stays
+                         # in the evidence file.
+                         *((("runs_finished", "runs"),
+                            ("runs_resumed", "resumed"),
+                            ("total_sec", "wall_s"))
+                           if name == "fleet_smoke" else ()),
                          # The resident-pool layout rides the line only
                          # where it is the phase's SUBJECT (the
                          # sharded-ceiling probe) — a row-sharded max-N

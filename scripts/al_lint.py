@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """al_lint: the whole-package static-analysis CLI (DESIGN.md §12).
 
-Runs the 16-check registry (10 legacy trace_lint invariants + the
+Runs the 18-check registry (10 legacy trace_lint invariants + the
 lock-discipline / donation-safety / recompile-hazard /
 collective-axis / diagnostics-inert / wal-before-ack
 deep checkers) over active_learning_tpu/, bench.py, and scripts/

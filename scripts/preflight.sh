@@ -3,9 +3,9 @@
 #
 #   bash scripts/preflight.sh
 #
-# Chains the five gates a change must clear, fail-fast, in cost order:
+# Chains the six gates a change must clear, fail-fast, in cost order:
 #
-#   1. al_lint         the 16-check static analysis (seconds, no jax)
+#   1. al_lint         the 18-check static analysis (seconds, no jax)
 #   2. tier-1 tests    the ROADMAP.md tier-1 recipe (CPU 8-device mesh)
 #   3. bench smoke     the degraded-mode contract: bench.py with the
 #                      wall-clock budget pre-exhausted and a redirected
@@ -18,6 +18,12 @@
 #                      bench stream_round phase in smoke mode)
 #   5. run_report      scripts/run_report.py --selftest (the reporting
 #                      layer renders synthetic runs end to end)
+#   6. fleet smoke     the fleet controller end to end: a 2-worker
+#                      localhost fleet runs a 2-run sweep, one child is
+#                      SIGKILL'd after its round-0 checkpoint, the
+#                      controller reschedules it with --resume_training
+#                      and both runs finish (the bench fleet_smoke
+#                      phase)
 #
 # Exit codes: 0 = every gate green; otherwise the exit code of the
 # FIRST failing gate (1 = lint findings or test/selftest failures,
@@ -28,10 +34,10 @@ set -euo pipefail
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO"
 
-echo "== preflight 1/5: al_lint (static analysis) =="
+echo "== preflight 1/6: al_lint (static analysis) =="
 python scripts/al_lint.py
 
-echo "== preflight 2/5: tier-1 tests =="
+echo "== preflight 2/6: tier-1 tests =="
 # The tier-1 recipe (ROADMAP.md): CPU backend, virtual 8-device mesh
 # via tests/conftest.py, slow tier excluded.
 set -o pipefail
@@ -40,7 +46,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_preflight_t1.log
 
-echo "== preflight 3/5: bench degraded-mode smoke =="
+echo "== preflight 3/6: bench degraded-mode smoke =="
 # Budget pre-exhausted + redirected state dir (the repo's captured
 # evidence must never be clobbered): the final stdout line must still
 # be strict JSON with the headline schema — the same contract
@@ -59,7 +65,7 @@ for key in ("metric", "value", "unit", "phases", "evidence"):
 print("bench degraded-mode line: ok")
 EOF
 
-echo "== preflight 4/5: stream_round smoke (ingest -> trigger -> round) =="
+echo "== preflight 4/6: stream_round smoke (ingest -> trigger -> round) =="
 # The streaming loop's end-to-end gate: the bench child in smoke mode
 # must ingest rows over HTTP, fire the watermark trigger, and complete
 # a full AL round — its JSON line is checked for the trigger evidence.
@@ -79,7 +85,32 @@ print("stream_round smoke: ok "
       f"({out['ips']} rows/s acked, ack p99 {out.get('ack_p99_ms')} ms)")
 EOF
 
-echo "== preflight 5/5: run_report selftest =="
+echo "== preflight 5/6: run_report selftest =="
 python scripts/run_report.py --selftest
+
+echo "== preflight 6/6: fleet smoke (2-worker controller, kill -> resume) =="
+# The fleet layer's end-to-end gate: the bench fleet_smoke phase runs
+# a 2-run sweep on two localhost workers, SIGKILLs one child after its
+# round-0 checkpoint, and the controller must reschedule it with
+# --resume_training and finish everything — the JSON line is checked
+# for the resume evidence.
+timeout -k 10 900 env -u XLA_FLAGS JAX_PLATFORMS=cpu \
+    python bench.py --phase fleet_smoke \
+    --iters 2 --per-chip-batch 32 > "$BENCH_STATE/fleet.txt"
+python - "$BENCH_STATE/fleet.txt" <<'EOF2'
+import json, sys
+lines = [l for l in open(sys.argv[1]).read().splitlines() if l.strip()]
+assert lines, "fleet_smoke printed nothing to stdout"
+out = json.loads(lines[-1])
+assert out.get("phase") == "fleet_smoke", out
+assert out.get("runs_finished") == 2, f"fleet did not finish: {out}"
+assert out.get("runs_failed") == 0, out
+assert out.get("runs_resumed", 0) >= 1, f"no resume exercised: {out}"
+assert out.get("comparison_rendered") is True, out
+print("fleet smoke: ok "
+      f"({out['runs_finished']} runs finished, "
+      f"{out['runs_resumed']} resumed after the kill, "
+      f"{out['total_sec']} s wall)")
+EOF2
 
 echo "preflight: ALL GATES GREEN"

@@ -23,7 +23,7 @@ subprocess run, monkeypatched ``_py_files``) works unchanged:
 The four NEW checkers (lock-discipline, donation-safety,
 recompile-hazard, collective-axis) are deliberately NOT run here — this
 shim's contract is "identical verdicts to the legacy monolith";
-``scripts/al_lint.py`` is the full 15-check CLI.
+``scripts/al_lint.py`` is the full 18-check CLI.
 
 Stdlib + the (jax-free) analysis package only; exits 0 clean / 1 with
 findings on stderr.

@@ -1,0 +1,31 @@
+"""Golden negative fixture for the fleet-host-pure check: a "fleet"
+module that imports jax, references the jax name, journals through a
+bare json.dump, and ships a write_atomic_json that lost its rename —
+each marked line below is a finding the checker must produce."""
+
+_FLEET_MODULE = True
+
+import json
+import os
+
+import jax  # host-purity violation: jax import in a fleet module
+
+
+def worker_backend():
+    # host-purity violation: the jax name referenced on the head node.
+    return jax.devices()
+
+
+def save_state(path, payload):
+    with open(path, "w") as fh:
+        # atomic-journal violation: json.dump outside write_atomic_json
+        # — a fleet file write that can tear.
+        json.dump(payload, fh)
+
+
+def write_atomic_json(path, payload):
+    # violation: no os.replace — the "atomic" helper writes in place.
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.rename(tmp, path)

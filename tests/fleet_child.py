@@ -1,0 +1,55 @@
+"""The fleet chaos tests' run child: the REAL driver behind the REAL
+CLI flag surface, at test size.
+
+tests/test_fleet.py hands the fleet controller this script as its
+``base_cmd`` — the controller appends exactly the argv it would hand
+``python -m active_learning_tpu``, and this harness parses it with the
+production parser (experiment/cli.get_parser + args_to_config), then
+runs run_experiment with the tier-1 test fixtures (TinyClassifier,
+tiny_train_config, 96-row synthetic data) instead of a real dataset.
+Everything the fleet layer consumes — heartbeats, the round journal,
+SIGTERM checkpoint-and-exit, ``--resume_training`` bit-identical
+resume, the Prometheus scrape file, run_report.json — is the driver's
+own machinery, untouched.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+_TESTS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TESTS)
+for path in (_REPO, _TESTS):
+    if path not in sys.path:
+        sys.path.insert(0, path)
+
+
+def main(argv=None):
+    from helpers import TinyClassifier, tiny_train_config
+
+    from active_learning_tpu.data.synthetic import get_data_synthetic
+    from active_learning_tpu.experiment.cli import (args_to_config,
+                                                    get_parser)
+    from active_learning_tpu.experiment.driver import run_experiment
+    from active_learning_tpu.faults.preempt import PreemptionRequested
+
+    cfg = args_to_config(get_parser().parse_args(argv))
+    # Fixed data config: the standalone baselines in test_fleet.py build
+    # the same arrays, so experiment_state comparisons are meaningful.
+    data = get_data_synthetic(n_train=96, n_test=32, num_classes=4,
+                              image_size=8, seed=5)
+    try:
+        run_experiment(cfg, data=data, train_cfg=tiny_train_config(),
+                       model=TinyClassifier(num_classes=4))
+    except PreemptionRequested:
+        return 0  # the CLI's mapping: graceful preemption exits 0
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

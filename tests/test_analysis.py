@@ -6,7 +6,7 @@ slow jax suite spins up — and the red NAMES its check id instead of
 "trace_lint failed".
 
 Pinned here:
-  * the whole 16-check run over the live tree is CLEAN (unsuppressed),
+  * the whole 18-check run over the live tree is CLEAN (unsuppressed),
     completes under the 5 s budget, and parses each file at most once
     (the shared-AST-cache contract — the reason the engine exists);
   * every checker in the registry has a golden negative-case fixture
@@ -84,7 +84,7 @@ def run_fixture(check_id: str):
 
 class TestPackageClean:
     def test_full_run_clean_fast_single_parse(self):
-        """THE tier-1 gate: 17 checks over the whole package — zero
+        """THE tier-1 gate: 18 checks over the whole package — zero
         unsuppressed findings, every suppression carries a reason, the
         run fits the 5 s budget, and no file parses twice."""
         report = run_package_analysis()

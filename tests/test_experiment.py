@@ -849,6 +849,17 @@ class TestBenchEvidence:
                          pool_disk_rows=50000, pool_over_budget_x=4.0,
                          ips_memory=4100.2, disk_vs_memory=0.873,
                          picks_identical=True)
+        if name == "fleet_smoke":
+            # The fleet tier (ISSUE 18): runs finished / resumed and
+            # the fleet wall ride the line; the attempt/kill detail is
+            # evidence-file-only.
+            extra.update(unit="runs finished/min (2-worker localhost "
+                              "fleet)",
+                         runs_finished=2, runs_failed=0, runs_resumed=1,
+                         attempts_total=3,
+                         killed_run="MarginSampler-synthetic-8-0-abcd1234",
+                         merged_prom_runs=2, comparison_rendered=True,
+                         total_sec=131.5, workers=2)
         return self._entry(name, **extra)
 
     def test_compact_line_bounded_all_phases_full(self, capsys, tmp_path):
@@ -881,6 +892,12 @@ class TestBenchEvidence:
         assert out["phases"]["disk_pool_feed"]["stall_ms"] == 41.75
         assert "disk_vs_memory" not in out["phases"]["disk_pool_feed"]
         assert out["phases"]["stream_round"]["ack_p99"] == 142.375
+        # The fleet tier's riders (ISSUE 18) — the 16-phase maximal
+        # line still fits the tail window.
+        assert out["phases"]["fleet_smoke"]["runs"] == 2
+        assert out["phases"]["fleet_smoke"]["resumed"] == 1
+        assert out["phases"]["fleet_smoke"]["wall_s"] == 131.5
+        assert "killed_run" not in out["phases"]["fleet_smoke"]
         # The file carries what the line dropped.
         with open(bench.EVIDENCE_PATH) as fh:
             full = json.load(fh)

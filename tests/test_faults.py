@@ -94,7 +94,7 @@ class TestSpecGrammar:
         assert faults.SITES == ("h2d_upload", "ckpt_write", "spec_scorer",
                                 "feed_worker", "shard_upload", "dispatch",
                                 "grad_probe", "wal_write", "stream_drain",
-                                "page_read")
+                                "page_read", "fleet_journal")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,890 @@
+"""The fleet layer, failure mode by failure mode (DESIGN.md §17).
+
+Unit tiers first — spec expansion and stable run-ids, the atomic fleet
+journal (including the injected torn write), the status exit-code
+contract the controller consumes, gen_jobs' fleet rendering, and the
+controller's scheduling decisions exercised against fake run children
+(tiny scripts that speak the round-journal protocol without paying for
+jax).  Then the chaos end-to-end: a REAL 4-run sweep on two localhost
+workers through ``python -m active_learning_tpu fleet run``, with a
+SIGKILL'd worker mid-round AND a SIGTERM'd controller mid-schedule, a
+controller restart from the journal, and a bit-identical comparison of
+every finished experiment_state against the same runs executed
+standalone — the fleet layer provably adds scheduling, not noise.
+"""
+
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from glob import glob
+
+import numpy as np
+import pytest
+
+from active_learning_tpu import faults
+from active_learning_tpu.experiment import gen_jobs
+from active_learning_tpu.experiment.cli import get_parser as run_parser
+from active_learning_tpu.faults import preempt as preempt_lib
+from active_learning_tpu.fleet import (FLEET_JOURNAL_FILE, FleetController,
+                                       FleetJournal, Worker,
+                                       default_base_cmd, expand_spec,
+                                       load_spec, read_fleet_journal,
+                                       run_argv, run_id_for,
+                                       write_atomic_json)
+from active_learning_tpu.fleet import cli as fleet_cli
+from active_learning_tpu.fleet import controller as controller_mod
+from active_learning_tpu.fleet import report as fleet_report
+from active_learning_tpu.fleet.spec import validate_spec
+from active_learning_tpu.telemetry import heartbeat as hb_lib
+from active_learning_tpu.telemetry import prom
+from active_learning_tpu.telemetry import status as status_lib
+from active_learning_tpu.telemetry.report import RUN_REPORT_FILE
+from active_learning_tpu.telemetry.status import strict_exit_code
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "fleet_child.py")
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Fault-registry hygiene (the test_faults discipline): every test
+    starts and ends disarmed, with no pending preemption flag."""
+    faults.configure(None)
+    preempt_lib.reset()
+    yield
+    faults.configure(None)
+    preempt_lib.reset()
+
+
+# ---------------------------------------------------------------------------
+# Sweep specs
+# ---------------------------------------------------------------------------
+
+
+class TestSweepSpec:
+    SPEC = {
+        "name": "demo",
+        "defaults": {"dataset": "synthetic", "rounds": 2},
+        "grid": {"strategy": ["MarginSampler", "RandomSampler"],
+                 "run_seed": [0, 1]},
+        "runs": [{"strategy": "BADGESampler", "partitions": 4}],
+    }
+
+    def test_expansion_count_and_order(self):
+        recs = expand_spec(self.SPEC)
+        assert len(recs) == 5
+        # Grid product in declaration order, later axes fastest, then
+        # the explicit runs.
+        combos = [(r["args"]["strategy"], r["args"].get("run_seed"))
+                  for r in recs]
+        assert combos == [("MarginSampler", 0), ("MarginSampler", 1),
+                          ("RandomSampler", 0), ("RandomSampler", 1),
+                          ("BADGESampler", None)]
+        # Defaults merge under every record.
+        assert all(r["args"]["dataset"] == "synthetic" for r in recs)
+        assert recs[-1]["args"]["partitions"] == 4
+
+    def test_run_ids_stable_and_distinct(self):
+        a = [r["run_id"] for r in expand_spec(self.SPEC)]
+        b = [r["run_id"] for r in expand_spec(json.loads(
+            json.dumps(self.SPEC)))]
+        assert a == b  # same spec -> same ids, across serialization
+        assert len(set(a)) == len(a)
+        # The slug keeps the id readable; the hash keeps it unique.
+        assert a[0].startswith("MarginSampler-synthetic")
+
+    def test_any_differing_arg_changes_the_id(self):
+        base = {"strategy": "MarginSampler", "run_seed": 0}
+        assert run_id_for(base) != run_id_for({**base, "run_seed": 1})
+        assert run_id_for(base) != run_id_for({**base, "n_epoch": 3})
+
+    def test_duplicate_runs_collide_loudly(self):
+        spec = {"name": "dup", "grid": {"run_seed": [0]},
+                "runs": [{"run_seed": 0}]}
+        with pytest.raises(ValueError, match="identical args"):
+            expand_spec(spec)
+
+    @pytest.mark.parametrize("bad, match", [
+        ({"grid": {}, "runs": []}, "zero runs"),
+        ({"grid": {"x": []}}, "non-empty list"),
+        ({"grid": 3}, "must be an object"),
+        ({"grid": {"x": 3}}, "non-empty list"),
+        ({"defaults": 3, "grid": {"x": [1]}}, "'defaults'"),
+        ({"grid": {"x": [1]}, "gird": {}}, "unknown top-level"),
+        ({"runs": "nope"}, "'runs'"),
+    ])
+    def test_validation_rejects(self, bad, match):
+        with pytest.raises(ValueError, match=match):
+            validate_spec(bad)
+
+    def test_run_argv_mapping(self):
+        argv = run_argv({"strategy": "MarginSampler",
+                         "freeze_feature": True,
+                         "download_data": False,
+                         "subset_labeled": None,
+                         "round_budget": 8})
+        assert argv == ["--strategy", "MarginSampler",
+                        "--freeze_feature", "--round_budget", "8"]
+
+    def test_spec_round_trips_through_the_real_parser(self, tmp_path):
+        path = tmp_path / "spec.json"
+        path.write_text(json.dumps(self.SPEC))
+        for rec in expand_spec(load_spec(str(path))):
+            args = run_parser().parse_args(run_argv(rec["args"]))
+            assert args.dataset == "synthetic"
+
+
+# ---------------------------------------------------------------------------
+# The atomic fleet journal
+# ---------------------------------------------------------------------------
+
+
+class TestFleetJournal:
+    def test_merge_semantics_and_seq(self, tmp_path):
+        path = str(tmp_path / FLEET_JOURNAL_FILE)
+        j = FleetJournal(path)
+        j.write(a=1, b=2)
+        j.write(b=None, c=3)  # None deletes
+        payload = read_fleet_journal(path)
+        assert payload["a"] == 1 and payload["c"] == 3
+        assert "b" not in payload
+        assert payload["seq"] == 2 and "ts" in payload
+
+    def test_seq_continues_across_controller_lives(self, tmp_path):
+        path = str(tmp_path / FLEET_JOURNAL_FILE)
+        FleetJournal(path).write(a=1)
+        second = FleetJournal(path)  # a restarted controller
+        second.write(b=2)
+        assert read_fleet_journal(path)["seq"] == 2
+
+    def test_disabled_journal_writes_nothing(self, tmp_path):
+        path = str(tmp_path / FLEET_JOURNAL_FILE)
+        assert FleetJournal(path, enabled=False).write(a=1) is None
+        assert not os.path.exists(path)
+
+    def test_write_failure_returns_false(self):
+        # /dev/null is a file, so the journal's parent "directory"
+        # cannot be created: the OSError is absorbed, not raised.
+        assert write_atomic_json("/dev/null/x/journal.json",
+                                 {"a": 1}) is False
+
+    def test_torn_write_leaves_previous_complete_journal(self, tmp_path):
+        """The fleet_journal fault site's torn point fires between the
+        tmp write and the rename: the injected crash propagates, the
+        on-disk journal is still the PREVIOUS complete payload (never a
+        splice), and the journal keeps working once disarmed."""
+        path = str(tmp_path / FLEET_JOURNAL_FILE)
+        j = FleetJournal(path)
+        j.write(round=1)
+        faults.configure("fleet_journal:torn@1")
+        with pytest.raises(faults.InjectedFault):
+            j.write(round=2)
+        assert faults.fault_counters()["fleet_journal"]["fires"] == 1
+        survivor = read_fleet_journal(path)
+        assert survivor["round"] == 1 and survivor["seq"] == 1
+        # The complete tmp file sits beside the old journal — the crash
+        # happened after the write, before the publish.
+        (tmp,) = glob(path + ".tmp.*")
+        assert json.load(open(tmp))["round"] == 2
+        faults.configure(None)
+        j.write(round=3)
+        final = read_fleet_journal(path)
+        assert final["round"] == 3 and final["seq"] == 3
+
+
+# ---------------------------------------------------------------------------
+# The status contract the controller consumes
+# ---------------------------------------------------------------------------
+
+
+class TestStatusContract:
+    @pytest.mark.parametrize("summary, code", [
+        ({"state": "no-heartbeat"}, 2),
+        ({"state": "stale", "degraded": True}, 3),  # staleness beats it
+        ({"state": "ok", "degraded": True, "ingest_starved": True}, 4),
+        ({"state": "ok", "ingest_starved": True}, 5),
+        ({"state": "ok"}, 0),
+    ])
+    def test_strict_exit_code_pins(self, summary, code):
+        assert strict_exit_code(summary) == code
+
+    def test_json_output_carries_the_exit_code(self, tmp_path, capsys):
+        rc = status_lib.main(["--log_dir", str(tmp_path),
+                              "--strict", "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 2
+        assert payload["exit_code"] == 2
+        assert payload["state"] == "no-heartbeat"
+
+    def test_non_strict_downgrades_degraded(self, tmp_path, capsys,
+                                            monkeypatch):
+        monkeypatch.setattr(
+            status_lib, "summarize",
+            lambda *a, **k: {"state": "ok", "degraded": True})
+        log = ["--log_dir", str(tmp_path), "--json"]
+        assert status_lib.main(log + ["--strict"]) == 4
+        assert status_lib.main(log) == 0
+        # ...and the JSON payload reports the code it EXITS with.
+        capsys.readouterr()
+        status_lib.main(log)
+        assert json.loads(capsys.readouterr().out)["exit_code"] == 0
+
+
+# ---------------------------------------------------------------------------
+# gen_jobs --format fleet
+# ---------------------------------------------------------------------------
+
+
+class TestGenJobsFleet:
+    def test_fleet_spec_covers_all_38_runs(self):
+        spec = gen_jobs.fleet_spec("/data")
+        recs = expand_spec(validate_spec(spec))
+        assert len(recs) == 38
+        assert len({r["run_id"] for r in recs}) == 38
+        # One grid definition, two renderings: every fleet run is one
+        # of the shell commands, token for token.
+        shell = set(gen_jobs.all_jobs("/data"))
+        for rec in recs:
+            cmd = " ".join([gen_jobs.CLI] + run_argv(rec["args"]))
+            assert cmd in shell
+
+    def test_sweep_narrowing(self):
+        spec = gen_jobs.fleet_spec("/data", sweep="cifar10")
+        assert spec["name"] == "cifar10"
+        assert len(expand_spec(spec)) == len(
+            gen_jobs.cifar10_experiments("/data"))
+        with pytest.raises(ValueError, match="unknown sweep"):
+            gen_jobs.fleet_spec("/data", sweep="mnist")
+
+    def test_main_fleet_format_prints_a_loadable_spec(self, tmp_path,
+                                                      capsys):
+        gen_jobs.main(["/data", "--format", "fleet"])
+        out = capsys.readouterr().out
+        path = tmp_path / "spec.json"
+        path.write_text(out)
+        assert len(expand_spec(load_spec(str(path)))) == 38
+
+    def test_every_fleet_run_parses_with_the_real_cli(self):
+        for rec in expand_spec(gen_jobs.fleet_spec("/data")):
+            run_parser().parse_args(run_argv(rec["args"]))
+
+
+# ---------------------------------------------------------------------------
+# The controller against fake run children
+# ---------------------------------------------------------------------------
+
+# A run child in ~40 lines: speaks the round-journal protocol, records
+# its argv, honors FAKE_MODE — the controller cannot tell it from the
+# real CLI, and the tests don't pay for jax.
+_FAKE_CHILD = r"""
+import json, os, sys, time
+
+def flag(name, default=None):
+    return sys.argv[sys.argv.index(name) + 1] if name in sys.argv \
+        else default
+
+log_dir = flag("--log_dir"); ckpt = flag("--ckpt_path")
+exp_name = flag("--exp_name")
+os.makedirs(log_dir, exist_ok=True)
+with open(os.path.join(log_dir, "argv.jsonl"), "a") as fh:
+    fh.write(json.dumps(sys.argv[1:]) + "\n")
+
+def journal(status):
+    with open(os.path.join(log_dir, "round_journal.json"), "w") as fh:
+        json.dump({"status": status}, fh)
+
+def save_state():
+    d = os.path.join(ckpt, exp_name + "_fleet")
+    os.makedirs(d, exist_ok=True)
+    for name in ("experiment_state.npz", "experiment_state.json"):
+        open(os.path.join(d, name), "w").close()
+
+mode = os.environ.get("FAKE_MODE", "finish")
+marker = os.path.join(log_dir, "attempted")
+first = not os.path.exists(marker)
+open(marker, "w").close()
+
+if mode == "sleep":
+    time.sleep(120)
+if mode == "preempt_once" and "--resume_training" not in sys.argv:
+    save_state(); journal("preempted"); sys.exit(0)
+if mode == "crash_once" and first:
+    sys.exit(3)
+if mode == "crash_always":
+    sys.exit(3)
+journal("finished")
+sys.exit(0)
+"""
+
+
+@pytest.fixture
+def fake_child(tmp_path):
+    path = tmp_path / "fake_child.py"
+    path.write_text(_FAKE_CHILD)
+    return str(path)
+
+
+def _tiny_spec(n=2):
+    return {"name": "tiny",
+            "defaults": {"dataset": "synthetic", "rounds": 1},
+            "grid": {"run_seed": list(range(n))}}
+
+
+def _controller(tmp_path, fake_child, workers=None, spec=None, **kw):
+    return FleetController(
+        str(tmp_path / "fleet"), spec or _tiny_spec(),
+        workers if workers is not None else [Worker("w0", 2)],
+        base_cmd=[sys.executable, fake_child], **kw)
+
+
+class TestControllerScheduling:
+    def test_dry_run_emits_commands_and_launches_nothing(self, tmp_path):
+        ctrl = FleetController(str(tmp_path / "fleet"), _tiny_spec(),
+                               [], dry_run=True)
+        cmds = ctrl.schedule_once()
+        assert len(cmds) == 2
+        for cmd in cmds:
+            assert cmd[:3] == default_base_cmd()
+            args = run_parser().parse_args(cmd[3:])
+            assert args.exp_hash == "fleet"
+            assert args.prometheus_file.endswith("run.prom")
+        assert all(r["state"] == "queued" for r in ctrl.runs.values())
+        # The journal and fleet gauges still record the fleet's shape.
+        journal = read_fleet_journal(
+            os.path.join(ctrl.fleet_dir, FLEET_JOURNAL_FILE))
+        assert len(journal["runs"]) == 2
+
+    def test_controller_flags_override_spec_redirection(self, tmp_path):
+        # A spec entry trying to redirect log_dir loses: the
+        # controller's flags come after, and argparse takes the last.
+        spec = {"name": "sneaky",
+                "runs": [{"log_dir": "/tmp/elsewhere",
+                          "run_seed": 0}]}
+        ctrl = FleetController(str(tmp_path / "fleet"), spec, [],
+                               dry_run=True)
+        (cmd,) = ctrl.schedule_once()
+        args = run_parser().parse_args(cmd[3:])
+        assert args.log_dir.startswith(ctrl.fleet_dir)
+
+    def test_cli_dry_run(self, tmp_path, capsys):
+        spec_path = tmp_path / "spec.json"
+        spec_path.write_text(json.dumps(_tiny_spec()))
+        rc = fleet_cli.main(["run", "--spec", str(spec_path),
+                             "--fleet_dir", str(tmp_path / "fleet"),
+                             "--dry_run"])
+        assert rc == 0
+        lines = [l for l in capsys.readouterr().out.splitlines() if l]
+        assert len(lines) == 2
+        for line in lines:
+            assert shlex.split(line)[:3] == default_base_cmd()
+
+    def test_fleet_finishes_and_journals(self, tmp_path, fake_child):
+        ctrl = _controller(tmp_path, fake_child, poll_every_s=0.05)
+        counts = ctrl.run()
+        assert counts == {"queued": 0, "running": 0,
+                          "finished": 2, "failed": 0}
+        journal = read_fleet_journal(
+            os.path.join(ctrl.fleet_dir, FLEET_JOURNAL_FILE))
+        assert journal["controller"]["status"] == "finished"
+        assert all(r["state"] == "finished"
+                   for r in journal["runs"].values())
+        gauges = prom.parse(open(os.path.join(
+            ctrl.fleet_dir, controller_mod.FLEET_PROM_FILE)).read())
+        assert next(iter(
+            gauges["al_fleet_runs_finished"].values())) == 2.0
+
+    def test_worker_env_overlay_wins(self, tmp_path, fake_child,
+                                     monkeypatch):
+        monkeypatch.setenv("FAKE_MODE", "crash_always")
+        ctrl = _controller(
+            tmp_path, fake_child,
+            workers=[Worker("w0", 2, env={"FAKE_MODE": "finish"})],
+            poll_every_s=0.05)
+        assert ctrl.run()["finished"] == 2
+
+    def test_clean_preemption_requeues_with_resume(self, tmp_path,
+                                                   fake_child,
+                                                   monkeypatch):
+        monkeypatch.setenv("FAKE_MODE", "preempt_once")
+        ctrl = _controller(tmp_path, fake_child, poll_every_s=0.05)
+        counts = ctrl.run()
+        assert counts["finished"] == 2
+        for rid, run in ctrl.runs.items():
+            assert run["attempts"] == 2
+            assert run["preemptions"] == 1 and run["resumes"] == 1
+            argvs = [json.loads(l) for l in open(os.path.join(
+                ctrl.log_dir(rid), "argv.jsonl"))]
+            assert "--resume_training" not in argvs[0]
+            assert "--resume_training" in argvs[1]
+
+    def test_crash_requeues_without_resume_state(self, tmp_path,
+                                                 fake_child,
+                                                 monkeypatch):
+        # A SIGKILL'd/crashed child left no saved experiment: the rerun
+        # is a cold start (no --resume_training), not a bogus resume.
+        monkeypatch.setenv("FAKE_MODE", "crash_once")
+        ctrl = _controller(tmp_path, fake_child, poll_every_s=0.05)
+        assert ctrl.run()["finished"] == 2
+        for rid, run in ctrl.runs.items():
+            assert run["attempts"] == 2 and run["resumes"] == 0
+            for line in open(os.path.join(ctrl.log_dir(rid),
+                                          "argv.jsonl")):
+                assert "--resume_training" not in json.loads(line)
+
+    def test_max_attempts_parks_as_failed(self, tmp_path, fake_child,
+                                          monkeypatch):
+        monkeypatch.setenv("FAKE_MODE", "crash_always")
+        ctrl = _controller(tmp_path, fake_child, max_attempts=2,
+                           poll_every_s=0.05)
+        counts = ctrl.run()
+        assert counts["failed"] == 2
+        assert all(r["attempts"] == 2 for r in ctrl.runs.values())
+
+    def test_cli_exit_code_reflects_failures(self, tmp_path, fake_child,
+                                             monkeypatch):
+        monkeypatch.setenv("FAKE_MODE", "crash_always")
+        spec_path = tmp_path / "spec.json"
+        spec_path.write_text(json.dumps(_tiny_spec()))
+        saved = {sig: signal.getsignal(sig)
+                 for sig in (signal.SIGTERM, signal.SIGINT)}
+        try:
+            rc = fleet_cli.main(
+                ["run", "--spec", str(spec_path),
+                 "--fleet_dir", str(tmp_path / "fleet"),
+                 "--workers", "w0=2", "--max_attempts", "1",
+                 "--poll_every_s", "0.05",
+                 "--base_cmd", f"{sys.executable} {fake_child}"])
+        finally:
+            for sig, handler in saved.items():
+                signal.signal(sig, handler)
+        assert rc == 1
+
+    def test_packing_respects_worker_capacity(self, tmp_path, fake_child,
+                                              monkeypatch):
+        monkeypatch.setenv("FAKE_MODE", "sleep")
+        ctrl = _controller(tmp_path, fake_child, spec=_tiny_spec(3),
+                           workers=[Worker("w0", 2), Worker("w1", 1)])
+        try:
+            ctrl.schedule_once()
+            placed = sorted(
+                (rid, run["worker"])
+                for rid, run in ctrl.runs.items()
+                if run["state"] == "running")
+            # Deterministic packing: sorted run-ids onto registration-
+            # ordered free slots.
+            assert [w for _, w in placed] == ["w0", "w0", "w1"]
+        finally:
+            for child in ctrl._children.values():
+                child.kill()
+
+    def test_stale_heartbeat_kills_and_requeues(self, tmp_path,
+                                                fake_child, monkeypatch):
+        """Failure mode 'run wedges': strict code 3 -> the child is
+        killed and the reap path re-queues it like any preemption."""
+        monkeypatch.setenv("FAKE_MODE", "sleep")
+        ctrl = _controller(tmp_path, fake_child, spec=_tiny_spec(1))
+        monkeypatch.setattr(controller_mod, "strict_exit_code",
+                            lambda summary: 3)
+        try:
+            ctrl.schedule_once()  # launch
+            (rid,) = ctrl.runs
+            ctrl.schedule_once()  # health poll -> SIGKILL
+            deadline = time.monotonic() + 10
+            while (ctrl._children[rid].poll() is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            ctrl.schedule_once()  # reap -> requeue
+            run = ctrl.runs[rid]
+            assert run["state"] == "queued" or run["attempts"] >= 1
+            assert run["health"] == 3
+        finally:
+            for child in ctrl._children.values():
+                child.kill()
+
+
+class TestControllerRecovery:
+    def _dead_pid(self):
+        proc = subprocess.Popen([sys.executable, "-c", "pass"])
+        proc.wait()
+        return proc.pid
+
+    def _record(self, state, pid=None, **extra):
+        rec = {"state": state, "worker": "w0", "pid": pid, "attempts": 1,
+               "resumes": 0, "preemptions": 0, "health": None,
+               "rc": None, "resume": False}
+        rec.update(extra)
+        return rec
+
+    def test_restart_requeues_dead_and_keeps_finished(self, tmp_path,
+                                                      fake_child):
+        spec = _tiny_spec()
+        rid0, rid1 = (r["run_id"] for r in expand_spec(spec))
+        fleet_dir = tmp_path / "fleet"
+        FleetJournal(str(fleet_dir / FLEET_JOURNAL_FILE)).write(
+            spec_name="tiny", runs={
+                rid0: self._record("running", pid=self._dead_pid()),
+                rid1: self._record("finished", rc=0),
+            })
+        ctrl = _controller(tmp_path, fake_child)
+        assert ctrl.runs[rid0]["state"] == "queued"
+        assert ctrl.runs[rid1]["state"] == "finished"
+        # seq continued: the journal is one ordered history.
+        ctrl.schedule_once()
+        assert read_fleet_journal(
+            str(fleet_dir / FLEET_JOURNAL_FILE))["seq"] >= 2
+
+    def test_restart_adopts_live_pid_never_relaunches(self, tmp_path,
+                                                      fake_child):
+        spec = _tiny_spec(1)
+        (rid,) = (r["run_id"] for r in expand_spec(spec))
+        fleet_dir = tmp_path / "fleet"
+        live = subprocess.Popen([sys.executable, "-c",
+                                 "import time; time.sleep(120)"])
+        try:
+            FleetJournal(str(fleet_dir / FLEET_JOURNAL_FILE)).write(
+                spec_name="tiny",
+                runs={rid: self._record("running", pid=live.pid)})
+            ctrl = _controller(tmp_path, fake_child, spec=spec,
+                               workers=[Worker("w0", 1)])
+            assert ctrl.runs[rid]["state"] == "running"
+            assert rid in ctrl._children
+            assert ctrl._children[rid].adopted()
+            # No free slot is double-booked while the adoptee lives.
+            assert ctrl._free_slots() == []
+        finally:
+            live.kill()
+            live.wait()
+
+    def test_adopted_death_judged_by_round_journal(self, tmp_path,
+                                                   fake_child):
+        """An adopted pid grants no wait() rights: when it dies, the
+        round journal supplies the verdict — finished sticks, anything
+        else re-queues."""
+        spec = _tiny_spec()
+        rid0, rid1 = (r["run_id"] for r in expand_spec(spec))
+        fleet_dir = tmp_path / "fleet"
+        p0 = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(120)"])
+        p1 = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(120)"])
+        try:
+            FleetJournal(str(fleet_dir / FLEET_JOURNAL_FILE)).write(
+                spec_name="tiny", runs={
+                    rid0: self._record("running", pid=p0.pid),
+                    rid1: self._record("running", pid=p1.pid)})
+            # Dry-run mode still reaps adopted children but never
+            # launches — the reap verdicts stand alone for inspection.
+            ctrl = FleetController(str(tmp_path / "fleet"), spec, [],
+                                   dry_run=True)
+            assert ctrl._children[rid0].adopted()
+            os.makedirs(ctrl.log_dir(rid0), exist_ok=True)
+            with open(os.path.join(ctrl.log_dir(rid0),
+                                   "round_journal.json"), "w") as fh:
+                json.dump({"status": "finished"}, fh)
+            for p in (p0, p1):
+                p.kill()
+                p.wait()
+            ctrl.schedule_once()
+            assert ctrl.runs[rid0]["state"] == "finished"
+            assert ctrl.runs[rid1]["state"] == "queued"
+        finally:
+            for p in (p0, p1):
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+
+
+# ---------------------------------------------------------------------------
+# Fleet reporting
+# ---------------------------------------------------------------------------
+
+
+def _fabricate_fleet(root, runs):
+    """A dead fleet directory: journal + per-run report/scrape
+    artifacts, the shape report.py answers from."""
+    fleet_dir = os.path.join(root, "fleet")
+    records = {}
+    for rid, (strategy, accs, state) in runs.items():
+        log_dir = os.path.join(fleet_dir, "runs", rid, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        rows = [{"round": i, "labeled": 16 * (i + 1),
+                 "cumulative_budget": 16 * (i + 1),
+                 "test_accuracy": a, "round_time_s": 1.0,
+                 "wall_clock_s": 2.0 * (i + 1)}
+                for i, a in enumerate(accs)]
+        with open(os.path.join(log_dir, RUN_REPORT_FILE), "w") as fh:
+            json.dump({"schema": 1, "exp_name": rid,
+                       "strategy": strategy, "rounds": rows}, fh)
+        prom.write_textfile(
+            os.path.join(fleet_dir, "runs", rid, "run.prom"),
+            prom.render(prom.gauge_samples(
+                {"round": float(len(accs) - 1), "fault_retries_total": 1.0,
+                 "degrade_events": 0.0}, prefix="al_run_")))
+        records[rid] = {"state": state, "worker": None, "pid": None,
+                        "attempts": 1, "resumes": 1, "preemptions": 1,
+                        "health": 0, "rc": 0, "resume": False}
+    FleetJournal(os.path.join(fleet_dir, FLEET_JOURNAL_FILE)).write(
+        spec_name="fab", runs=records,
+        controller={"pid": 1234, "status": "finished"})
+    return fleet_dir
+
+
+class TestFleetReport:
+    RUNS = {
+        "margin-0-aaaaaaaa": ("MarginSampler", [0.30, 0.52, 0.61],
+                              "finished"),
+        "random-0-bbbbbbbb": ("RandomSampler", [0.28, 0.45, 0.50],
+                              "finished"),
+    }
+
+    def test_payload_counts_and_progress(self, tmp_path):
+        fleet_dir = _fabricate_fleet(str(tmp_path), self.RUNS)
+        payload = fleet_report.fleet_payload(fleet_dir)
+        assert payload["counts"] == {"finished": 2}
+        assert payload["resumes_total"] == 2
+        assert payload["preemptions_total"] == 2
+        assert payload["comparison"] is not None
+        for rec in payload["runs"]:
+            assert rec["round"] == 2.0  # from the scrape file
+            assert rec["fault_retries"] == 1.0
+
+    def test_render_contains_lifecycle_and_comparison(self, tmp_path):
+        fleet_dir = _fabricate_fleet(str(tmp_path), self.RUNS)
+        text = fleet_report.render_fleet(
+            fleet_report.fleet_payload(fleet_dir))
+        assert "margin-0-aaaaaaaa" in text
+        assert "strategy comparison at matched label budgets" in text
+        # MarginSampler wins every matched budget in this fabrication.
+        assert "*" in text
+
+    def test_merge_prom_relabels_with_run_id(self, tmp_path):
+        fleet_dir = _fabricate_fleet(str(tmp_path), self.RUNS)
+        path, merged = fleet_report.merge_prom(fleet_dir)
+        assert merged == 2
+        gauges = prom.parse(open(path).read())
+        labels = {dict(l)["run_id"]
+                  for l in gauges["al_run_round"]}
+        assert labels == set(self.RUNS)
+
+    def test_as_json_is_machine_clean(self, tmp_path):
+        fleet_dir = _fabricate_fleet(str(tmp_path), self.RUNS)
+        payload = json.loads(fleet_report.as_json(
+            fleet_report.fleet_payload(fleet_dir)))
+        assert "_reports" not in payload
+        assert payload["spec_name"] == "fab"
+        assert payload["comparison"]["runs"][0]["curve"]
+
+    def test_cli_status_and_report(self, tmp_path, capsys):
+        fleet_dir = _fabricate_fleet(str(tmp_path), self.RUNS)
+        assert fleet_cli.main(["status", "--fleet_dir", fleet_dir]) == 0
+        out = capsys.readouterr().out
+        assert "finished" in out
+        assert fleet_cli.main(["report", "--fleet_dir", fleet_dir]) == 0
+        out = capsys.readouterr().out
+        assert "strategy comparison at matched label budgets" in out
+        assert os.path.exists(os.path.join(
+            fleet_dir, fleet_report.MERGED_PROM_FILE))
+        # --json round-trips.
+        fleet_cli.main(["status", "--fleet_dir", fleet_dir, "--json"])
+        assert json.loads(capsys.readouterr().out)["counts"] == {
+            "finished": 2}
+
+    def test_journal_loss_falls_back_to_artifacts(self, tmp_path):
+        fleet_dir = _fabricate_fleet(str(tmp_path), self.RUNS)
+        os.remove(os.path.join(fleet_dir, FLEET_JOURNAL_FILE))
+        payload = fleet_report.fleet_payload(fleet_dir)
+        assert {r["run_id"] for r in payload["runs"]} == set(self.RUNS)
+        assert payload["comparison"] is not None
+
+
+# ---------------------------------------------------------------------------
+# The chaos end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _heartbeat_resumable(log_dir):
+    """True once the run's heartbeat shows round >= 1.  The driver
+    persists experiment_state at each round's END before ticking the
+    next round_start, so a heartbeat at round 1 proves the round-0
+    checkpoint is on disk — a SIGKILL now MUST reschedule with
+    --resume_training."""
+    hb = hb_lib.read_heartbeat(
+        os.path.join(log_dir, "heartbeat.json")) or {}
+    return (hb.get("round") or 0) >= 1 and hb.get("status") == "running"
+
+
+def _state_arrays(ckpt_root):
+    paths = glob(os.path.join(ckpt_root, "*", "experiment_state.npz"))
+    assert len(paths) == 1, f"expected one state under {ckpt_root}"
+    return dict(np.load(paths[0]))
+
+
+@pytest.mark.slow
+class TestFleetChaosE2E:
+    """The acceptance scenario: 4 runs (2 strategies x 2 seeds) on two
+    localhost workers; one child SIGKILL'd mid-run past its round-0
+    checkpoint (so the reschedule must resume); the controller
+    SIGTERM'd mid-schedule; a second controller restarts from the
+    fleet journal and completes everything; every finished
+    experiment_state is bit-identical to the same run executed
+    standalone (no controller, no preemption).  Slow tier like the
+    other multi-process spawns (pytest.ini): two controller lives plus
+    eight driver children."""
+
+    SPEC = {
+        "name": "chaos",
+        "defaults": {
+            "dataset": "synthetic", "arg_pool": "synthetic",
+            # Three rounds: the SIGKILL waits for a round-1 heartbeat
+            # (checkpoint committed), and the survivor still has most
+            # of its run left when the controller is SIGTERM'd — so
+            # the handoff reliably catches it MID-round (preempted),
+            # not between runs.
+            "rounds": 3, "round_budget": 8, "n_epoch": 3,
+            "early_stop_patience": 3, "round_pipeline": "speculative",
+            "heartbeat_every_s": 0.0,
+            # Stretch scoring dispatches so rounds are not instant.
+            "fault_spec": "dispatch:delay@0.05",
+        },
+        "grid": {"strategy": ["MarginSampler", "RandomSampler"],
+                 "run_seed": [0, 1]},
+    }
+
+    def _controller_cmd(self, spec_path, fleet_dir):
+        return [sys.executable, "-m", "active_learning_tpu", "fleet",
+                "run", "--spec", spec_path, "--fleet_dir", fleet_dir,
+                "--workers", "w0,w1", "--poll_every_s", "0.2",
+                "--base_cmd", f"{sys.executable} {CHILD}"]
+
+    def test_preempted_fleet_matches_standalone(self, tmp_path):
+        spec_path = str(tmp_path / "spec.json")
+        with open(spec_path, "w") as fh:
+            json.dump(self.SPEC, fh)
+        fleet_dir = str(tmp_path / "fleet")
+        recs = expand_spec(self.SPEC)
+        assert len(recs) == 4
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+        # -- life 1: launch, SIGKILL one child mid-fit, SIGTERM the
+        # controller while work remains.
+        ctrl = subprocess.Popen(
+            self._controller_cmd(spec_path, fleet_dir), env=env,
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        journal_path = os.path.join(fleet_dir, FLEET_JOURNAL_FILE)
+        killed = None
+        try:
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline and killed is None:
+                journal = read_fleet_journal(journal_path) or {}
+                for rid, rec in (journal.get("runs") or {}).items():
+                    if rec.get("state") != "running" or not rec.get("pid"):
+                        continue
+                    log_dir = os.path.join(fleet_dir, "runs", rid, "logs")
+                    if not _heartbeat_resumable(log_dir):
+                        continue
+                    attempt0 = rec.get("attempts", 1)
+                    try:
+                        os.kill(rec["pid"], signal.SIGKILL)
+                    except ProcessLookupError:
+                        continue  # finished under us; hunt another
+                    # Confirm the kill TOOK: the controller must see
+                    # the death and requeue (attempts grows or state
+                    # returns to queued).  A zombie killed after its
+                    # natural exit lands 'finished' instead — not a
+                    # victim, keep hunting.
+                    sub_deadline = time.monotonic() + 60
+                    while time.monotonic() < sub_deadline:
+                        vrec = ((read_fleet_journal(journal_path) or {})
+                                .get("runs") or {}).get(rid) or {}
+                        if vrec.get("state") == "queued" or \
+                                vrec.get("attempts", 0) > attempt0:
+                            killed = rid
+                            break
+                        if vrec.get("state") in ("finished", "failed"):
+                            break
+                        time.sleep(0.05)
+                    break  # re-read the journal either way
+                if ctrl.poll() is not None:
+                    pytest.fail("controller exited before the kill:\n"
+                                + ctrl.communicate()[0][-2000:])
+                time.sleep(0.05)
+            assert killed, \
+                "no running child was ever killed past its round-0 save"
+            # Preempt the controller itself immediately — the handoff
+            # SIGTERMs surviving children mid-round (they journal
+            # 'preempted' and exit 0) and requeues them.
+            ctrl.send_signal(signal.SIGTERM)
+            out, _ = ctrl.communicate(timeout=120)
+            assert ctrl.returncode == 0, out[-2000:]
+        finally:
+            if ctrl.poll() is None:
+                ctrl.kill()
+                ctrl.communicate()
+        journal = read_fleet_journal(journal_path)
+        assert journal["controller"]["status"] == "preempted"
+        states = {rec["state"] for rec in journal["runs"].values()}
+        assert states <= {"queued", "finished"}
+        assert "queued" in states  # the preemption left real work
+
+        # -- life 2: restart from the journal, run to completion.
+        ctrl = subprocess.Popen(
+            self._controller_cmd(spec_path, fleet_dir), env=env,
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            out, _ = ctrl.communicate(timeout=840)
+            assert ctrl.returncode == 0, out[-2000:]
+        finally:
+            if ctrl.poll() is None:
+                ctrl.kill()
+                ctrl.communicate()
+        journal = read_fleet_journal(journal_path)
+        assert journal["controller"]["status"] == "finished"
+        runs = journal["runs"]
+        assert all(r["state"] == "finished" for r in runs.values())
+        assert sum(r["resumes"] for r in runs.values()) >= 1
+        assert sum(r["preemptions"] for r in runs.values()) >= 1
+
+        # -- the fleet report renders the matched-budget comparison.
+        report = subprocess.run(
+            [sys.executable, "-m", "active_learning_tpu", "fleet",
+             "report", "--fleet_dir", fleet_dir],
+            env=env, cwd=REPO, capture_output=True, text=True)
+        assert report.returncode == 0, report.stderr[-2000:]
+        assert "strategy comparison at matched label budgets" \
+            in report.stdout
+        assert os.path.exists(os.path.join(
+            fleet_dir, fleet_report.MERGED_PROM_FILE))
+
+        # -- bit-identity: each run standalone (same harness, no
+        # controller, no preemption) produces the same final state.
+        # Sequential on purpose: the comparison needs determinism, not
+        # wall-clock, and N concurrent jax children thrash small boxes.
+        base_root = str(tmp_path / "standalone")
+        for rec in recs:
+            rid = rec["run_id"]
+            argv = run_argv(rec["args"]) + [
+                "--exp_name", rid, "--exp_hash", "fleet",
+                "--log_dir", os.path.join(base_root, rid, "logs"),
+                "--ckpt_path", os.path.join(base_root, rid, "ckpt")]
+            done = subprocess.run(
+                [sys.executable, CHILD] + argv, env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, timeout=570)
+            assert done.returncode == 0, f"{rid}:\n{done.stdout[-2000:]}"
+        for rec in recs:
+            rid = rec["run_id"]
+            fleet_state = _state_arrays(
+                os.path.join(fleet_dir, "runs", rid, "ckpt"))
+            base_state = _state_arrays(
+                os.path.join(base_root, rid, "ckpt"))
+            assert fleet_state.keys() == base_state.keys()
+            for key in fleet_state:
+                assert np.array_equal(fleet_state[key],
+                                      base_state[key]), \
+                    f"{rid}: {key} diverged from the standalone run"
